@@ -1,0 +1,12 @@
+"""photon-tpu: a TPU-native GLM / GLMix (GAME) training framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of LinkedIn Photon-ML
+(Spark/Scala): generalized linear models (linear, logistic, Poisson,
+smoothed-hinge SVM), GLMix mixed-effect models trained by block coordinate
+descent, L-BFGS / OWL-QN / TRON optimizers, normalization, evaluation,
+hyperparameter tuning, and Avro-compatible model I/O — with Spark RDD
+machinery replaced by sharded device arrays, XLA collectives, and vmapped
+batched per-entity solvers.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,166 @@
+"""Pointwise GLM loss kernels: l(z, y) with first and second derivatives in z.
+
+TPU-native counterpart of the reference's ``PointwiseLossFunction`` hierarchy
+(photon-lib function/glm/PointwiseLossFunction.scala:38-57 and the concrete
+losses in photon-api function/glm/*.scala, function/svm/SmoothedHingeLossFunction.scala).
+
+Each loss is a set of pure elementwise jnp functions over a margin array
+``z = offset + X @ w`` and a label array ``y`` — they vmap/fuse trivially into
+the surrounding matvec, so there is no per-sample streaming aggregator here:
+the whole "aggregator" layer of the reference collapses into
+``sum(weight * loss(z, y))`` under jit.
+
+Semantics match the reference exactly:
+
+- logistic  (LogisticLossFunction.scala:84): labels in {0,1} (or {-1,1}, where
+  anything <= 0.5 is negative); l = log(1+exp(z)) - 1[y>0.5] * z.
+- squared   (SquaredLossFunction.scala:43): l = (z-y)^2 / 2.
+- poisson   (PoissonLossFunction.scala): l = exp(z) - y*z.
+- smoothed hinge (SmoothedHingeLossFunction.scala:34, Rennie's smooth hinge):
+  labels mapped to {-1,1}; piecewise quadratic; no true second derivative —
+  the reference substitutes an identity-Hessian approximation (dzz = 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+# Threshold above which a label counts as a positive response
+# (reference: MathConst.POSITIVE_RESPONSE_THRESHOLD = 0.5).
+POSITIVE_RESPONSE_THRESHOLD = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise loss l(z, y) with derivatives in the margin z.
+
+    Attributes:
+      name: stable identifier.
+      loss: elementwise l(z, y).
+      dz: elementwise dl/dz.
+      dzz: elementwise d2l/dz2 (Gauss-Newton weight). For the smoothed hinge
+        this is the reference's identity approximation.
+      mean: the inverse link function mapping margin -> E[y] for prediction.
+    """
+
+    name: str
+    loss: Callable[[Array, Array], Array]
+    dz: Callable[[Array, Array], Array]
+    dzz: Callable[[Array, Array], Array]
+    mean: Callable[[Array], Array]
+
+    def loss_and_dz(self, z: Array, y: Array) -> tuple[Array, Array]:
+        return self.loss(z, y), self.dz(z, y)
+
+
+def _is_positive(y: Array) -> Array:
+    return (y > POSITIVE_RESPONSE_THRESHOLD).astype(jnp.result_type(y, jnp.float32))
+
+
+def _logistic_loss(z: Array, y: Array) -> Array:
+    # log(1+exp(z)) - y01*z, stable for large |z| via softplus.
+    return jax.nn.softplus(z) - _is_positive(y) * z
+
+
+def _logistic_dz(z: Array, y: Array) -> Array:
+    return jax.nn.sigmoid(z) - _is_positive(y)
+
+
+def _logistic_dzz(z: Array, y: Array) -> Array:
+    s = jax.nn.sigmoid(z)
+    return s * (1.0 - s)
+
+
+def _squared_loss(z: Array, y: Array) -> Array:
+    d = z - y
+    return 0.5 * d * d
+
+
+def _poisson_loss(z: Array, y: Array) -> Array:
+    return jnp.exp(z) - y * z
+
+
+def _sign_label(y: Array) -> Array:
+    """Map {0,1}-style labels to {-1,+1} (reference SmoothedHingeLossFunction:46)."""
+    dt = jnp.result_type(y, jnp.float32)
+    return jnp.where(y < POSITIVE_RESPONSE_THRESHOLD, -1.0, 1.0).astype(dt)
+
+
+def _smoothed_hinge_loss(z: Array, y: Array) -> Array:
+    t = _sign_label(y) * z
+    # t <= 0: 0.5 - t ; 0 < t < 1: 0.5*(1-t)^2 ; t >= 1: 0
+    return jnp.where(t <= 0.0, 0.5 - t, jnp.where(t < 1.0, 0.5 * (1.0 - t) ** 2, 0.0))
+
+
+def _smoothed_hinge_dz(z: Array, y: Array) -> Array:
+    s = _sign_label(y)
+    t = s * z
+    dt = jnp.where(t < 0.0, -1.0, jnp.where(t < 1.0, t - 1.0, 0.0))
+    return dt * s
+
+
+LOGISTIC = PointwiseLoss(
+    name="logistic",
+    loss=_logistic_loss,
+    dz=_logistic_dz,
+    dzz=_logistic_dzz,
+    mean=jax.nn.sigmoid,
+)
+
+SQUARED = PointwiseLoss(
+    name="squared",
+    loss=_squared_loss,
+    dz=lambda z, y: z - y,
+    dzz=lambda z, y: jnp.ones_like(z),
+    mean=lambda z: z,
+)
+
+POISSON = PointwiseLoss(
+    name="poisson",
+    loss=_poisson_loss,
+    dz=lambda z, y: jnp.exp(z) - y,
+    dzz=lambda z, y: jnp.exp(z),
+    mean=jnp.exp,
+)
+
+SMOOTHED_HINGE = PointwiseLoss(
+    name="smoothed_hinge",
+    loss=_smoothed_hinge_loss,
+    dz=_smoothed_hinge_dz,
+    # Reference uses an identity Hessian approximation for the smoothed hinge
+    # (no DzzLoss; SingleNode/DistributedSmoothedHingeLossFunction are
+    # DiffFunction-only). dzz=1 keeps TRON usable with the same caveat.
+    dzz=lambda z, y: jnp.ones_like(z),
+    mean=lambda z: z,
+)
+
+_BY_NAME = {
+    loss.name: loss for loss in (LOGISTIC, SQUARED, POISSON, SMOOTHED_HINGE)
+}
+
+_BY_TASK = {
+    TaskType.LOGISTIC_REGRESSION: LOGISTIC,
+    TaskType.LINEAR_REGRESSION: SQUARED,
+    TaskType.POISSON_REGRESSION: POISSON,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SMOOTHED_HINGE,
+}
+
+
+def get_loss(name_or_task: str | TaskType) -> PointwiseLoss:
+    """Look up a pointwise loss by name or by training task."""
+    if isinstance(name_or_task, TaskType):
+        return _BY_TASK[name_or_task]
+    try:
+        return _BY_NAME[name_or_task]
+    except KeyError:
+        raise ValueError(
+            f"Unknown loss {name_or_task!r}; known: {sorted(_BY_NAME)}"
+        ) from None

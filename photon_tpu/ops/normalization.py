@@ -1,0 +1,198 @@
+"""Feature normalization as pure affine algebra on coefficient vectors.
+
+TPU-native counterpart of the reference's ``NormalizationContext``
+(photon-lib normalization/NormalizationContext.scala:37-176) and
+``NormalizationType`` (normalization/NormalizationType.scala:42).
+
+The transform is x' = (x - shift) * factor elementwise, with the intercept
+column never shifted (shift[intercept] == 0) nor scaled (factor[intercept] == 1).
+Optimization runs in the transformed space; coefficients round-trip to the
+original space keeping margins identical:
+
+    w  = w' * factor;          b  = b' - (w . shift)   (all shift mass -> intercept)
+    w' = w / factor;           b' = b + (w . shift)
+
+Rather than materializing transformed copies of the data, the GLM objective
+uses the *effective coefficients* rewrite from the reference's aggregators
+(ValueAndGradientAggregator.scala:62-88): for margins over transformed
+features,
+
+    x' . w' = (x - shift) * factor . w' = x . (factor * w') - shift . (factor * w')
+            = x . ew - es,   ew = factor * w',   es = shift . ew
+
+so the hot matvec always runs on the raw (sparse) data with rewritten
+coefficients — one extra scalar per batch, zero data movement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class NormalizationType(enum.Enum):
+    """Reference: NormalizationType.scala:42."""
+
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """Affine feature transform x' = (x - shift) * factor.
+
+    ``factors is None`` means all-ones; ``shifts is None`` means all-zeros
+    (and then no intercept is required). A default-constructed instance is
+    no-normalization. This is a pytree so it can ride through jit boundaries.
+    """
+
+    factors: Array | None = None
+    shifts: Array | None = None
+    intercept_index: int | None = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
+
+    def __post_init__(self):
+        if self.shifts is not None and self.intercept_index is None:
+            raise ValueError(
+                "Normalization with shifts requires an intercept "
+                "(reference NormalizationContext.scala:49)"
+            )
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    # --- effective-coefficient rewrite (the hot path) -----------------------
+
+    def effective_coefficients(self, coef: Array) -> tuple[Array, Array]:
+        """Return (ew, es) such that margin = x . ew - es for raw features x.
+
+        Reference: ValueAndGradientAggregator.scala:62-88 (effectiveCoefficients
+        and totalShift).
+        """
+        ew = coef if self.factors is None else coef * self.factors
+        if self.shifts is None:
+            es = jnp.zeros((), dtype=coef.dtype)
+        else:
+            es = jnp.dot(self.shifts.astype(coef.dtype), ew)
+        return ew, es
+
+    def effective_gradient(self, raw_grad: Array, grad_dot_total: Array) -> Array:
+        """Map a gradient aggregated against *raw* features into transformed space.
+
+        d margin / d w'_j = factor_j * (x_j - shift_j), so
+        grad'_j = factor_j * (raw_grad_j - shift_j * sum_i g_i)
+        where ``raw_grad = X^T g`` and ``grad_dot_total = sum_i g_i``.
+        Reference folds this into the aggregator's vectorShiftPrefactorSum.
+        """
+        g = raw_grad
+        if self.shifts is not None:
+            g = g - self.shifts.astype(g.dtype) * grad_dot_total
+        if self.factors is not None:
+            g = g * self.factors.astype(g.dtype)
+        return g
+
+    # --- coefficient space round-trips --------------------------------------
+
+    def coef_to_original_space(self, coef: Array) -> Array:
+        """Transformed-space coefficients -> original space, margin-preserving.
+
+        Reference: NormalizationContext.coefToOriginalSpace (scala:77-95):
+        w = w' * factor, then intercept -= w . shift.
+        """
+        out = coef if self.factors is None else coef * self.factors
+        if self.shifts is not None:
+            adj = jnp.dot(out, self.shifts.astype(out.dtype))
+            out = out.at[self.intercept_index].add(-adj)
+        return out
+
+    def coef_to_transformed_space(self, coef: Array) -> Array:
+        """Original-space coefficients -> transformed space (scala:111-129):
+        intercept += w . shift, then w' = w / factor.
+        """
+        out = coef
+        if self.shifts is not None:
+            adj = jnp.dot(out, self.shifts.astype(out.dtype))
+            out = out.at[self.intercept_index].add(adj)
+        if self.factors is not None:
+            out = out / self.factors
+        return out
+
+    def var_to_transformed_space(self, variances: Array) -> Array:
+        """Coefficient variances original -> transformed: Var(w') = Var(w)/factor^2.
+
+        Reference: NormalizationContext.varToTransformedSpace (scala:145-160).
+        Used when converting a prior model for incremental training.
+        """
+        if self.factors is None:
+            return variances
+        return variances / (self.factors * self.factors)
+
+
+def no_normalization() -> NormalizationContext:
+    """Reference: NoNormalization()."""
+    return NormalizationContext()
+
+
+def build_normalization_context(
+    normalization_type: NormalizationType,
+    *,
+    mean: Array | None = None,
+    variance: Array | None = None,
+    min_: Array | None = None,
+    max_: Array | None = None,
+    intercept_index: int | None = None,
+) -> NormalizationContext:
+    """Build a NormalizationContext from per-feature statistics.
+
+    Mirrors NormalizationContext.apply(normalizationType, summary)
+    (scala:162-220): zero std / zero magnitude features get factor 1 so that
+    constant columns pass through untouched.
+    """
+    if normalization_type == NormalizationType.NONE:
+        return no_normalization()
+
+    if normalization_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        if min_ is None or max_ is None:
+            raise ValueError("max-magnitude scaling needs min/max statistics")
+        magnitude = jnp.maximum(jnp.abs(max_), jnp.abs(min_))
+        factors = jnp.where(magnitude == 0.0, 1.0, 1.0 / jnp.where(magnitude == 0, 1.0, magnitude))
+        if intercept_index is not None:
+            factors = factors.at[intercept_index].set(1.0)
+        return NormalizationContext(factors=factors)
+
+    if normalization_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        if variance is None:
+            raise ValueError("std scaling needs variance statistics")
+        std = jnp.sqrt(variance)
+        factors = jnp.where(std == 0.0, 1.0, 1.0 / jnp.where(std == 0, 1.0, std))
+        if intercept_index is not None:
+            factors = factors.at[intercept_index].set(1.0)
+        return NormalizationContext(factors=factors)
+
+    if normalization_type == NormalizationType.STANDARDIZATION:
+        if variance is None or mean is None:
+            raise ValueError("standardization needs mean/variance statistics")
+        if intercept_index is None:
+            raise ValueError(
+                "standardization (shifting) requires an intercept column "
+                "(reference GameTrainingDriver normalization validation)"
+            )
+        std = jnp.sqrt(variance)
+        factors = jnp.where(std == 0.0, 1.0, 1.0 / jnp.where(std == 0, 1.0, std))
+        factors = factors.at[intercept_index].set(1.0)
+        shifts = mean.at[intercept_index].set(0.0)
+        return NormalizationContext(
+            factors=factors, shifts=shifts, intercept_index=intercept_index
+        )
+
+    raise ValueError(f"Unknown normalization type: {normalization_type}")

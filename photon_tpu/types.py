@@ -1,0 +1,49 @@
+"""Domain type aliases and enums.
+
+Mirrors the reference's typed-ID vocabulary (photon-lib Types.scala:21-44):
+``UniqueSampleId``, ``CoordinateId``, ``REType``, ``REId``, ``FeatureShardId``.
+On TPU these stay host-side Python types; device-side everything is integer
+row/bucket indices.
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Unique identifier of one sample (row) in a dataset.
+UniqueSampleId = int
+# Name of one coordinate in a GAME model update sequence (e.g. "global", "per-user").
+CoordinateId = str
+# A random-effect type, i.e. the name of the grouping column (e.g. "userId").
+REType = str
+# The id of one entity of a random-effect type (one user, one movie, ...).
+REId = str
+# Name of a feature shard (a bag-of-feature-bags a coordinate trains on).
+FeatureShardId = str
+# Feature name/term key: the reference joins Avro (name, term) pairs with a
+# delimiter into a flat string key (photon-client Constants).
+FeatureKey = str
+
+INTERCEPT_KEY: FeatureKey = "(INTERCEPT)"
+DELIMITER = "\x01"
+
+
+class TaskType(enum.Enum):
+    """Training task, determining loss function and link function.
+
+    Reference: photon-lib TaskType enum (LINEAR_REGRESSION, LOGISTIC_REGRESSION,
+    POISSON_REGRESSION, SMOOTHED_HINGE_LOSS_LINEAR_SVM).
+    """
+
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+
+
+def make_feature_key(name: str, term: str = "") -> FeatureKey:
+    """Join an Avro (name, term) pair into the flat feature key used by index maps.
+
+    Reference: Constants.DELIMITER usage in AvroDataReader.scala.
+    """
+    return f"{name}{DELIMITER}{term}"

@@ -1,0 +1,51 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+The reference tests "multi-node" logic with Spark local[*] mode
+(photon-test-utils SparkTestUtils.scala:43-76); the TPU-native equivalent is
+an 8-device host-platform CPU mesh, which exercises the same sharding,
+collective, and pjit code paths on one host.
+"""
+
+import os
+
+# Must be set before jax is first imported anywhere in the test process.
+# Explicit assignment (not setdefault): the outer environment may pin
+# JAX_PLATFORMS to a real accelerator; tests always run on the virtual
+# 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_ENABLE_X64"] = "1"
+
+import jax  # noqa: E402
+
+# The jaxtyping pytest plugin imports jax before this conftest runs, so the
+# env vars above are too late for jax's config defaults — but the XLA backend
+# itself is still uninitialized, so explicit config updates take effect.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh(devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices).reshape(4, 2), ("data", "model"))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260729)

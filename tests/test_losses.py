@@ -1,0 +1,94 @@
+"""Derivative and semantics checks for the pointwise loss kernels.
+
+Mirrors the reference's pure-JVM loss unit tests (value/derivative identities)
+using autodiff as the oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.ops import losses
+from photon_tpu.types import TaskType
+
+ALL = [losses.LOGISTIC, losses.SQUARED, losses.POISSON, losses.SMOOTHED_HINGE]
+LABELS = {
+    "logistic": np.array([0.0, 1.0, 0.0, 1.0, 1.0]),
+    "squared": np.array([-2.0, 0.3, 1.5, -0.7, 4.0]),
+    "poisson": np.array([0.0, 1.0, 3.0, 2.0, 5.0]),
+    "smoothed_hinge": np.array([0.0, 1.0, 0.0, 1.0, 1.0]),
+}
+Z = np.array([-3.0, -0.9, 0.0, 1.1, 4.0])
+
+
+@pytest.mark.parametrize("loss", ALL, ids=lambda l: l.name)
+def test_dz_matches_autodiff(loss):
+    y = jnp.asarray(LABELS[loss.name])
+    z = jnp.asarray(Z)
+    # Smoothed hinge is non-differentiable exactly at kinks t in {0, 1}; the
+    # sample margins avoid them.
+    auto = jax.vmap(jax.grad(lambda zi, yi: loss.loss(zi, yi)))(z, y)
+    np.testing.assert_allclose(loss.dz(z, y), auto, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("loss", [losses.LOGISTIC, losses.SQUARED, losses.POISSON],
+                         ids=lambda l: l.name)
+def test_dzz_matches_autodiff(loss):
+    y = jnp.asarray(LABELS[loss.name])
+    z = jnp.asarray(Z)
+    auto = jax.vmap(jax.grad(jax.grad(lambda zi, yi: loss.loss(zi, yi))))(z, y)
+    np.testing.assert_allclose(loss.dzz(z, y), auto, rtol=1e-12, atol=1e-12)
+
+
+def test_logistic_reference_values():
+    # l(z, y=1) = log(1+exp(-z)); l(z, y=0) = log(1+exp(z))
+    # (LogisticLossFunction.scala:84 docstring identities)
+    z = jnp.asarray([-2.0, 0.0, 3.0])
+    np.testing.assert_allclose(
+        losses.LOGISTIC.loss(z, jnp.ones(3)), np.log1p(np.exp(-np.asarray(z))), rtol=1e-12)
+    np.testing.assert_allclose(
+        losses.LOGISTIC.loss(z, jnp.zeros(3)), np.log1p(np.exp(np.asarray(z))), rtol=1e-12)
+    # Also works for {-1, 1} labels: -1 treated as negative.
+    np.testing.assert_allclose(
+        losses.LOGISTIC.loss(z, -jnp.ones(3)), np.log1p(np.exp(np.asarray(z))), rtol=1e-12)
+
+
+def test_logistic_stability_at_extreme_margins():
+    z = jnp.asarray([-500.0, 500.0])
+    v = losses.LOGISTIC.loss(z, jnp.asarray([1.0, 0.0]))
+    assert np.all(np.isfinite(np.asarray(v)))
+    np.testing.assert_allclose(v, [500.0, 500.0], rtol=1e-12)
+
+
+def test_smoothed_hinge_piecewise_values():
+    # Rennie smooth hinge, positive label: t=z; t<=0 -> 0.5-t; 0<t<1 -> 0.5(1-t)^2; t>=1 -> 0.
+    y = jnp.ones(4)
+    z = jnp.asarray([-1.0, 0.5, 1.0, 2.0])
+    np.testing.assert_allclose(
+        losses.SMOOTHED_HINGE.loss(z, y), [1.5, 0.125, 0.0, 0.0], rtol=1e-12)
+    # Negative (0-valued) label mirrors: t = -z.
+    np.testing.assert_allclose(
+        losses.SMOOTHED_HINGE.loss(-z, jnp.zeros(4)), [1.5, 0.125, 0.0, 0.0], rtol=1e-12)
+
+
+def test_poisson_reference_values():
+    z = jnp.asarray([0.0, 1.0])
+    y = jnp.asarray([2.0, 3.0])
+    np.testing.assert_allclose(
+        losses.POISSON.loss(z, y), np.exp(np.asarray(z)) - np.asarray(y) * np.asarray(z),
+        rtol=1e-12)
+
+
+def test_mean_link_functions():
+    z = jnp.asarray([0.0])
+    assert losses.LOGISTIC.mean(z)[0] == pytest.approx(0.5)
+    assert losses.POISSON.mean(z)[0] == pytest.approx(1.0)
+    assert losses.SQUARED.mean(z)[0] == pytest.approx(0.0)
+
+
+def test_lookup_by_task_and_name():
+    assert losses.get_loss(TaskType.LOGISTIC_REGRESSION) is losses.LOGISTIC
+    assert losses.get_loss("poisson") is losses.POISSON
+    with pytest.raises(ValueError):
+        losses.get_loss("nope")

@@ -1,0 +1,120 @@
+"""NormalizationContext algebra: margin preservation and round-trips.
+
+Mirrors reference NormalizationContextTest semantics
+(photon-lib normalization/NormalizationContext.scala:77-160).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.ops.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    build_normalization_context,
+    no_normalization,
+)
+
+
+@pytest.fixture
+def ctx_std(rng):
+    d = 6
+    intercept = d - 1
+    mean = rng.normal(size=d)
+    mean[intercept] = 0.0
+    var = rng.uniform(0.5, 2.0, size=d)
+    var[intercept] = 1.0
+    return build_normalization_context(
+        NormalizationType.STANDARDIZATION,
+        mean=jnp.asarray(mean),
+        variance=jnp.asarray(var),
+        intercept_index=intercept,
+    )
+
+
+def _margins(X, coef):
+    return X @ coef
+
+
+def test_margin_preserved_across_spaces(ctx_std, rng):
+    d = 6
+    intercept = d - 1
+    X = rng.normal(size=(10, d))
+    X[:, intercept] = 1.0  # intercept column
+    Xt = (X - np.asarray(ctx_std.shifts)) * np.asarray(ctx_std.factors)
+    coef_t = rng.normal(size=d)
+
+    coef_orig = ctx_std.coef_to_original_space(jnp.asarray(coef_t))
+    np.testing.assert_allclose(
+        _margins(X, np.asarray(coef_orig)), _margins(Xt, coef_t), rtol=1e-10)
+
+
+def test_round_trip(ctx_std, rng):
+    coef = jnp.asarray(rng.normal(size=6))
+    back = ctx_std.coef_to_transformed_space(ctx_std.coef_to_original_space(coef))
+    np.testing.assert_allclose(back, coef, rtol=1e-12)
+
+
+def test_effective_coefficients_margin_identity(ctx_std, rng):
+    """x'.w' == x.ew - es — the aggregator rewrite must match materialized transform."""
+    d = 6
+    intercept = d - 1
+    X = rng.normal(size=(10, d))
+    X[:, intercept] = 1.0
+    Xt = (X - np.asarray(ctx_std.shifts)) * np.asarray(ctx_std.factors)
+    coef_t = jnp.asarray(rng.normal(size=d))
+
+    ew, es = ctx_std.effective_coefficients(coef_t)
+    np.testing.assert_allclose(
+        X @ np.asarray(ew) - float(es), Xt @ np.asarray(coef_t), rtol=1e-10)
+
+
+def test_effective_gradient_matches_materialized(ctx_std, rng):
+    d = 6
+    X = rng.normal(size=(10, d))
+    X[:, d - 1] = 1.0
+    Xt = (X - np.asarray(ctx_std.shifts)) * np.asarray(ctx_std.factors)
+    g = rng.normal(size=10)  # pointwise dl/dz
+    want = Xt.T @ g
+    got = ctx_std.effective_gradient(jnp.asarray(X.T @ g), jnp.asarray(g.sum()))
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+
+def test_var_to_transformed_space(ctx_std):
+    var = jnp.ones(6) * 4.0
+    out = ctx_std.var_to_transformed_space(var)
+    np.testing.assert_allclose(out, 4.0 / np.asarray(ctx_std.factors) ** 2, rtol=1e-12)
+
+
+def test_identity_context_passthrough(rng):
+    ctx = no_normalization()
+    coef = jnp.asarray(rng.normal(size=4))
+    assert ctx.is_identity
+    np.testing.assert_array_equal(ctx.coef_to_original_space(coef), coef)
+    np.testing.assert_array_equal(ctx.coef_to_transformed_space(coef), coef)
+    ew, es = ctx.effective_coefficients(coef)
+    np.testing.assert_array_equal(ew, coef)
+    assert float(es) == 0.0
+
+
+def test_scale_with_std_zero_variance_gets_unit_factor():
+    ctx = build_normalization_context(
+        NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+        variance=jnp.asarray([4.0, 0.0, 1.0]),
+    )
+    np.testing.assert_allclose(ctx.factors, [0.5, 1.0, 1.0], rtol=1e-12)
+    assert ctx.shifts is None
+
+
+def test_max_magnitude_scaling():
+    ctx = build_normalization_context(
+        NormalizationType.SCALE_WITH_MAX_MAGNITUDE,
+        min_=jnp.asarray([-4.0, 0.0, -1.0]),
+        max_=jnp.asarray([2.0, 0.0, 8.0]),
+    )
+    np.testing.assert_allclose(ctx.factors, [0.25, 1.0, 0.125], rtol=1e-12)
+
+
+def test_shift_without_intercept_rejected():
+    with pytest.raises(ValueError):
+        NormalizationContext(shifts=jnp.zeros(3), intercept_index=None)
