@@ -1,0 +1,89 @@
+"""Optimizers: L-BFGS, OWL-QN, TRON as pure lax.while_loop programs.
+
+``solve`` mirrors the reference's OptimizerFactory dispatch
+(photon-lib optimization/OptimizerFactory.scala:74): LBFGS vs TRON by
+configured type, with OWL-QN substituted automatically whenever an L1 term is
+present.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from photon_tpu.optim.base import (
+    ConvergenceReason,
+    HessianVectorProduct,
+    OptResult,
+    OptimizerConfig,
+    OptimizerType,
+    Tolerances,
+    ValueAndGrad,
+)
+from photon_tpu.optim.lbfgs import lbfgs_solve
+from photon_tpu.optim.owlqn import owlqn_solve
+from photon_tpu.optim.regularization import (
+    RegularizationContext,
+    RegularizationType,
+    with_l2,
+    with_l2_hvp,
+)
+from photon_tpu.optim.tron import tron_solve
+
+Array = jax.Array
+
+__all__ = [
+    "ConvergenceReason",
+    "HessianVectorProduct",
+    "OptResult",
+    "OptimizerConfig",
+    "OptimizerType",
+    "RegularizationContext",
+    "RegularizationType",
+    "Tolerances",
+    "ValueAndGrad",
+    "lbfgs_solve",
+    "owlqn_solve",
+    "solve",
+    "tron_solve",
+    "with_l2",
+    "with_l2_hvp",
+]
+
+
+def solve(
+    fun: ValueAndGrad,
+    w0: Array,
+    config: OptimizerConfig | None = None,
+    *,
+    l1_weight: float = 0.0,
+    l2_weight: float = 0.0,
+    intercept_index: int | None = None,
+    hvp: HessianVectorProduct | None = None,
+    tolerances: Tolerances | None = None,
+) -> OptResult:
+    """Factory-style entry point: compose regularization onto ``fun`` and
+    dispatch to the right solver.
+
+    - L2 is folded into the objective closure (mixin equivalent,
+      intercept excluded);
+    - a nonzero L1 weight routes to OWL-QN regardless of configured type
+      (OptimizerFactory semantics — Breeze OWLQN replaces LBFGS when L1 is
+      present; TRON does not support L1 in the reference either);
+    - TRON requires an ``hvp``.
+    """
+    config = config or OptimizerConfig()
+    obj = fun if l2_weight == 0.0 else with_l2(fun, l2_weight, intercept_index)
+
+    if l1_weight != 0.0:
+        return owlqn_solve(obj, w0, l1_weight, config, tolerances=tolerances)
+
+    if config.optimizer_type == OptimizerType.TRON:
+        if hvp is None:
+            raise ValueError("TRON requires a Hessian-vector-product closure")
+        obj_hvp = (
+            hvp if l2_weight == 0.0
+            else with_l2_hvp(hvp, l2_weight, intercept_index)
+        )
+        return tron_solve(obj, obj_hvp, w0, config, tolerances=tolerances)
+
+    return lbfgs_solve(obj, w0, config, tolerances=tolerances)

@@ -1,0 +1,188 @@
+"""Optimizer protocol: configs, convergence reasons, results, tolerance setup.
+
+TPU-native counterpart of the reference's ``Optimizer`` skeleton
+(photon-lib optimization/Optimizer.scala:35-244) and
+``OptimizationStatesTracker`` (OptimizationStatesTracker.scala:121).
+
+Design: each solver is a pure function ``solve(fun, w0, cfg) -> OptResult``
+built from ``lax.while_loop`` steps with static shapes, so one and the same
+implementation serves both execution modes required by the GAME engine:
+
+- *distributed* (fixed effect): ``fun`` closes over row-sharded data; XLA
+  turns the contained reductions into ICI collectives under jit — this is the
+  moral equivalent of the reference's broadcast + treeAggregate per iteration
+  (ValueAndGradientAggregator.scala:299-320), minus the per-iteration host
+  round trip.
+- *batched* (random effects): the solver is ``vmap``-ed over an entity axis;
+  JAX's while_loop batching rule yields masked per-entity convergence
+  automatically (entities that converged stop changing), the TPU analog of
+  thousands of independent executor-local solves
+  (RandomEffectCoordinate.scala:243-292).
+
+Convergence semantics match Optimizer.scala:126-139 exactly: absolute
+tolerances are derived from the state at **zero coefficients**
+(Optimizer.scala setAbsTolerances usage in optimize :162-187), and the
+reasons are MAX_ITERATIONS / FUNCTION_VALUES_CONVERGED / GRADIENT_CONVERGED /
+OBJECTIVE_NOT_IMPROVING.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+# fun(w) -> (value, gradient). Everything the solver needs about data lives in
+# this closure.
+ValueAndGrad = Callable[[Array], tuple[Array, Array]]
+# hvp(w, d) -> H(w) @ d, for TRON's inner CG.
+HessianVectorProduct = Callable[[Array, Array], Array]
+
+
+class OptimizerType(enum.Enum):
+    """Reference: optimization/OptimizerType.scala (LBFGS, TRON)."""
+
+    LBFGS = "LBFGS"
+    TRON = "TRON"
+
+
+class ConvergenceReason(enum.IntEnum):
+    """Why the solver stopped. Integer-coded so batched solves can return one
+    per entity as an array (RandomEffectOptimizationTracker aggregates counts
+    of these, reference *Tracker.scala).
+
+    Reference: Optimizer.getConvergenceReason (Optimizer.scala:126-139).
+    """
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    FUNCTION_VALUES_CONVERGED = 2
+    GRADIENT_CONVERGED = 3
+    OBJECTIVE_NOT_IMPROVING = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Static solver configuration.
+
+    Reference: optimization/OptimizerConfig.scala + LBFGS.scala:148-154
+    (tolerance 1e-7, 100 iters, 10 corrections) and TRON.scala:251-256
+    (tolerance 1e-5, 15 iters, 5 improvement failures, 20 CG iters).
+    ``box_constraints`` mirrors the reference's constraintMap projection
+    (OptimizationUtils.projectCoefficientsToSubspace).
+    """
+
+    optimizer_type: OptimizerType = OptimizerType.LBFGS
+    tolerance: float = 1e-7
+    max_iterations: int = 100
+    num_corrections: int = 10
+    # TRON-specific
+    max_improvement_failures: int = 5
+    max_cg_iterations: int = 20
+    # Line-search (L-BFGS/OWL-QN)
+    max_line_search_iterations: int = 25
+    # Optional (lower, upper) arrays broadcastable to the coefficient shape.
+    box_constraints: tuple | None = None
+
+    @staticmethod
+    def lbfgs(**kw) -> "OptimizerConfig":
+        return OptimizerConfig(optimizer_type=OptimizerType.LBFGS, **kw)
+
+    @staticmethod
+    def tron(**kw) -> "OptimizerConfig":
+        kw.setdefault("tolerance", 1e-5)
+        kw.setdefault("max_iterations", 15)
+        return OptimizerConfig(optimizer_type=OptimizerType.TRON, **kw)
+
+
+class OptResult(NamedTuple):
+    """Solver output; a pytree so it flows through jit/vmap.
+
+    ``loss_history`` is fixed length ``max_iterations + 1`` padded with the
+    final value — the tracker equivalent of OptimizationStatesTracker's state
+    ring (per-iteration losses for observability / tests).
+    """
+
+    coefficients: Array
+    value: Array
+    gradient_norm: Array
+    iterations: Array
+    convergence_reason: Array  # int32, ConvergenceReason codes
+    loss_history: Array
+
+
+class Tolerances(NamedTuple):
+    loss_abs: Array
+    gradient_abs: Array
+
+
+def absolute_tolerances(fun: ValueAndGrad, template: Array, tolerance: float) -> Tolerances:
+    """Derive absolute tolerances from the zero-coefficient state.
+
+    Reference: Optimizer.optimize (Optimizer.scala:167-170) — 'We set the
+    absolute tolerances from the magnitudes of the first loss and gradient',
+    computed at zero coefficients even on warm start.
+    """
+    f0, g0 = fun(jnp.zeros_like(template))
+    return Tolerances(
+        loss_abs=jnp.abs(f0) * tolerance,
+        gradient_abs=_l2norm(g0) * tolerance,
+    )
+
+
+def _l2norm(x: Array) -> Array:
+    return jnp.sqrt(jnp.sum(x * x))
+
+
+def convergence_code(
+    *,
+    iteration: Array,
+    max_iterations: int,
+    loss_delta: Array,
+    gradient_norm: Array,
+    tol: Tolerances,
+    not_improving: Array | None = None,
+) -> Array:
+    """Evaluate the reference's convergence cascade and return a reason code
+    (0 if still running). Order matches Optimizer.scala:126-139.
+    """
+    # Cascade order matches the reference exactly: MaxIterations, then
+    # ObjectiveNotImproving (iter did not advance), then FunctionValues,
+    # then Gradient. A rejected step has loss_delta == 0, so NotImproving
+    # must be checked before the function-value test.
+    if not_improving is None:
+        not_improving = jnp.asarray(False)
+    code = jnp.where(
+        iteration >= max_iterations,
+        ConvergenceReason.MAX_ITERATIONS,
+        jnp.where(
+            not_improving,
+            ConvergenceReason.OBJECTIVE_NOT_IMPROVING,
+            jnp.where(
+                jnp.abs(loss_delta) <= tol.loss_abs,
+                ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+                jnp.where(
+                    gradient_norm <= tol.gradient_abs,
+                    ConvergenceReason.GRADIENT_CONVERGED,
+                    ConvergenceReason.NOT_CONVERGED,
+                ),
+            ),
+        ),
+    )
+    return code.astype(jnp.int32)
+
+
+def project_box(w: Array, box_constraints: tuple | None) -> Array:
+    """Project coefficients into box constraints after a step.
+
+    Reference: OptimizationUtils.projectCoefficientsToSubspace applied in
+    LBFGS.scala:56-79 and TRON.scala (post-accept projection).
+    """
+    if box_constraints is None:
+        return w
+    lower, upper = box_constraints
+    return jnp.clip(w, lower, upper)

@@ -1,0 +1,120 @@
+"""Regularization: contexts and objective-closure composition.
+
+TPU-native counterpart of the reference's stackable mixins:
+- ``RegularizationContext`` / ``RegularizationType`` with the elastic-net
+  alpha split of lambda into L1/L2 parts
+  (photon-lib optimization/RegularizationContext.scala:134).
+- ``L2Regularization`` traits adding the L2 term to value/gradient/Hessian
+  with the intercept excluded from the penalty
+  (photon-lib function/L2Regularization.scala:26-97).
+
+The Scala trait stacking becomes plain closure composition: ``with_l2`` wraps
+a ``fun(w) -> (value, grad)`` closure (and optionally an hvp closure). L1 is
+NOT handled here — as in the reference, the L1 term belongs to the OWL-QN
+optimizer itself (OWLQN.scala:39, OptimizerFactory substitution).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.optim.base import HessianVectorProduct, ValueAndGrad
+
+Array = jax.Array
+
+
+class RegularizationType(enum.Enum):
+    """Reference: optimization/RegularizationType.scala."""
+
+    NONE = "NONE"
+    L1 = "L1"
+    L2 = "L2"
+    ELASTIC_NET = "ELASTIC_NET"
+
+
+@dataclasses.dataclass(frozen=True)
+class RegularizationContext:
+    """Splits a total regularization weight lambda into L1/L2 parts.
+
+    For ELASTIC_NET, ``alpha`` is the L1 fraction: l1 = alpha * lambda,
+    l2 = (1 - alpha) * lambda (RegularizationContext.scala:134 semantics;
+    alpha defaults to 1.0 there, i.e. pure L1).
+    """
+
+    regularization_type: RegularizationType = RegularizationType.NONE
+    alpha: float | None = None
+
+    def __post_init__(self):
+        if self.regularization_type == RegularizationType.ELASTIC_NET:
+            a = 1.0 if self.alpha is None else self.alpha
+            if not (0.0 <= a <= 1.0):
+                raise ValueError(f"elastic net alpha must be in [0, 1]: {a}")
+        elif self.alpha is not None:
+            raise ValueError(
+                f"alpha is only valid for ELASTIC_NET, not {self.regularization_type}"
+            )
+
+    def l1_weight(self, reg_weight: float) -> float:
+        t = self.regularization_type
+        if t == RegularizationType.L1:
+            return reg_weight
+        if t == RegularizationType.ELASTIC_NET:
+            a = 1.0 if self.alpha is None else self.alpha
+            return a * reg_weight
+        return 0.0
+
+    def l2_weight(self, reg_weight: float) -> float:
+        t = self.regularization_type
+        if t == RegularizationType.L2:
+            return reg_weight
+        if t == RegularizationType.ELASTIC_NET:
+            a = 1.0 if self.alpha is None else self.alpha
+            return (1.0 - a) * reg_weight
+        return 0.0
+
+
+def _l2_mask(w: Array, intercept_index: int | None) -> Array:
+    if intercept_index is None:
+        return w
+    return w.at[intercept_index].set(0.0)
+
+
+def with_l2(
+    fun: ValueAndGrad,
+    l2_weight,
+    intercept_index: int | None = None,
+) -> ValueAndGrad:
+    """Add 0.5 * l2 * ||w||^2 (intercept excluded) to a value-and-grad closure.
+
+    Reference: L2Regularization.l2RegValue / l2RegGradient
+    (function/L2Regularization.scala:73-97, 126-140).
+    """
+
+    def wrapped(w: Array):
+        f, g = fun(w)
+        wm = _l2_mask(w, intercept_index)
+        return f + 0.5 * l2_weight * jnp.dot(wm, wm), g + l2_weight * wm
+
+    return wrapped
+
+
+def with_l2_hvp(
+    hvp: HessianVectorProduct,
+    l2_weight,
+    intercept_index: int | None = None,
+) -> HessianVectorProduct:
+    """Add the L2 term's Hessian contribution l2 * d (intercept row/col
+    excluded) to a Hessian-vector-product closure.
+
+    Reference: L2RegularizationTwiceDiff.l2RegHessianVector
+    (function/L2Regularization.scala:181-200).
+    """
+
+    def wrapped(w: Array, d: Array):
+        return hvp(w, d) + l2_weight * _l2_mask(d, intercept_index)
+
+    return wrapped

@@ -1,0 +1,220 @@
+"""Optimizer correctness: analytic objectives, external oracles, batching.
+
+Mirrors the reference's OptimizerIntegTest strategy (convergence on analytic
+objectives) plus oracle comparisons the reference can't do (scipy/sklearn).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu import optim
+from photon_tpu.ops import losses
+
+
+def quad_fun(A, b):
+    """f(w) = 0.5 w.A.w - b.w, minimum at A^-1 b."""
+
+    def fun(w):
+        Aw = A @ w
+        return 0.5 * jnp.dot(w, Aw) - jnp.dot(b, w), Aw - b
+
+    return fun
+
+
+def glm_fun(X, y, loss):
+    def fun(w):
+        z = X @ w
+        f = jnp.sum(loss.loss(z, y))
+        g = X.T @ loss.dz(z, y)
+        return f, g
+
+    return fun
+
+
+def glm_hvp(X, y, loss):
+    def hvp(w, d):
+        z = X @ w
+        return X.T @ (loss.dzz(z, y) * (X @ d))
+
+    return hvp
+
+
+@pytest.fixture
+def quad(rng):
+    d = 12
+    M = rng.normal(size=(d, d))
+    A = jnp.asarray(M @ M.T + 0.5 * np.eye(d))
+    b = jnp.asarray(rng.normal(size=d))
+    w_star = jnp.linalg.solve(A, b)
+    return A, b, w_star
+
+
+@pytest.fixture
+def logistic_problem(rng):
+    n, d = 500, 8
+    X = rng.normal(size=(n, d))
+    X[:, -1] = 1.0
+    w_true = rng.normal(size=d)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-X @ w_true))).astype(float)
+    return jnp.asarray(X), jnp.asarray(y)
+
+
+def test_lbfgs_quadratic_exact(quad):
+    A, b, w_star = quad
+    res = optim.lbfgs_solve(quad_fun(A, b), jnp.zeros_like(b))
+    np.testing.assert_allclose(res.coefficients, w_star, rtol=1e-5, atol=1e-6)
+    assert int(res.convergence_reason) in (2, 3)
+
+
+def test_tron_quadratic_exact(quad):
+    A, b, w_star = quad
+    fun = quad_fun(A, b)
+    res = optim.tron_solve(fun, lambda w, d: A @ d, jnp.zeros_like(b),
+                           optim.OptimizerConfig.tron())
+    np.testing.assert_allclose(res.coefficients, w_star, rtol=1e-4, atol=1e-5)
+
+
+def test_lbfgs_logistic_vs_scipy(logistic_problem):
+    from scipy.optimize import minimize as sp_minimize
+
+    X, y = logistic_problem
+    l2 = 1.0
+    fun = optim.with_l2(glm_fun(X, y, losses.LOGISTIC), l2,
+                        intercept_index=X.shape[1] - 1)
+    res = optim.lbfgs_solve(fun, jnp.zeros(X.shape[1]))
+
+    def np_obj(w):
+        f, g = fun(jnp.asarray(w))
+        return float(f), np.asarray(g)
+
+    sp = sp_minimize(np_obj, np.zeros(X.shape[1]), jac=True, method="L-BFGS-B",
+                     options=dict(maxiter=500, ftol=1e-14, gtol=1e-10))
+    np.testing.assert_allclose(res.coefficients, sp.x, rtol=2e-4, atol=2e-5)
+    assert float(res.value) <= sp.fun * (1 + 1e-6) + 1e-9
+
+
+def test_tron_matches_lbfgs_on_logistic(logistic_problem):
+    X, y = logistic_problem
+    l2 = 0.5
+    icept = X.shape[1] - 1
+    fun = optim.with_l2(glm_fun(X, y, losses.LOGISTIC), l2, intercept_index=icept)
+    hvp = optim.with_l2_hvp(glm_hvp(X, y, losses.LOGISTIC), l2, intercept_index=icept)
+    r1 = optim.lbfgs_solve(fun, jnp.zeros(X.shape[1]))
+    r2 = optim.tron_solve(fun, hvp, jnp.zeros(X.shape[1]),
+                          optim.OptimizerConfig.tron(max_iterations=50))
+    np.testing.assert_allclose(r1.coefficients, r2.coefficients, rtol=1e-3, atol=1e-4)
+
+
+def test_owlqn_lasso_vs_sklearn(rng):
+    from sklearn.linear_model import Lasso
+
+    n, d = 300, 10
+    X = rng.normal(size=(n, d))
+    w_true = np.zeros(d)
+    w_true[:3] = [2.0, -1.5, 0.7]
+    y = X @ w_true + 0.05 * rng.normal(size=n)
+
+    alpha = 0.1  # sklearn: (1/2n)||y-Xw||^2 + alpha*||w||_1
+    l1 = alpha * n  # ours: (1/2)sum residuals^2 + l1*||w||_1
+    fun = glm_fun(jnp.asarray(X), jnp.asarray(y), losses.SQUARED)
+    res = optim.owlqn_solve(fun, jnp.zeros(d), l1,
+                            optim.OptimizerConfig(max_iterations=500, tolerance=1e-10))
+
+    sk = Lasso(alpha=alpha, fit_intercept=False, tol=1e-12, max_iter=100000).fit(X, y)
+    np.testing.assert_allclose(res.coefficients, sk.coef_, rtol=5e-3, atol=5e-4)
+    # sparsity recovered
+    got_zero = np.abs(np.asarray(res.coefficients)) < 1e-8
+    want_zero = np.abs(sk.coef_) < 1e-8
+    np.testing.assert_array_equal(got_zero, want_zero)
+
+
+def test_owlqn_reduces_to_lbfgs_at_zero_l1(logistic_problem):
+    X, y = logistic_problem
+    fun = glm_fun(X, y, losses.LOGISTIC)
+    r_lb = optim.lbfgs_solve(fun, jnp.zeros(X.shape[1]))
+    r_ow = optim.owlqn_solve(fun, jnp.zeros(X.shape[1]), 0.0)
+    # Not bit-identical (the orthant projection still binds at sign
+    # crossings), but both must reach the same optimum.
+    np.testing.assert_allclose(r_ow.value, r_lb.value, rtol=1e-5)
+    np.testing.assert_allclose(r_ow.coefficients, r_lb.coefficients, atol=5e-3)
+
+
+def test_solve_dispatch(logistic_problem):
+    X, y = logistic_problem
+    fun = glm_fun(X, y, losses.LOGISTIC)
+    hvp = glm_hvp(X, y, losses.LOGISTIC)
+    # L1 routes to OWL-QN: solution should have zeros
+    res = optim.solve(fun, jnp.zeros(X.shape[1]), l1_weight=50.0)
+    assert int((np.abs(np.asarray(res.coefficients)) < 1e-10).sum()) > 0
+    # TRON without hvp rejected
+    with pytest.raises(ValueError):
+        optim.solve(fun, jnp.zeros(X.shape[1]),
+                    config=optim.OptimizerConfig.tron())
+    # TRON with hvp works
+    res2 = optim.solve(fun, jnp.zeros(X.shape[1]), hvp=hvp,
+                       config=optim.OptimizerConfig.tron())
+    assert float(res2.gradient_norm) < 1.0
+
+
+def test_max_iterations_reason(quad):
+    A, b, _ = quad
+    res = optim.lbfgs_solve(quad_fun(A, b), jnp.zeros_like(b),
+                            optim.OptimizerConfig(max_iterations=2, tolerance=1e-30))
+    assert int(res.convergence_reason) == int(optim.ConvergenceReason.MAX_ITERATIONS)
+    assert int(res.iterations) == 2
+
+
+def test_box_constraints_projection(quad):
+    A, b, w_star = quad
+    lo, hi = -0.05, 0.05
+    cfg = optim.OptimizerConfig(box_constraints=(lo, hi))
+    res = optim.lbfgs_solve(quad_fun(A, b), jnp.zeros_like(b), cfg)
+    assert float(jnp.max(res.coefficients)) <= hi + 1e-12
+    assert float(jnp.min(res.coefficients)) >= lo - 1e-12
+
+
+def test_vmapped_batched_solves_match_loop(rng):
+    """The random-effect execution mode: vmap over an entity batch."""
+    B, n, d = 6, 40, 5
+    Xs = rng.normal(size=(B, n, d))
+    ws = rng.normal(size=(B, d))
+    ys = (rng.uniform(size=(B, n)) < 1 / (1 + np.exp(-np.einsum("bnd,bd->bn", Xs, ws)))).astype(float)
+    Xs, ys = jnp.asarray(Xs), jnp.asarray(ys)
+
+    def solve_one(X, y):
+        fun = optim.with_l2(glm_fun(X, y, losses.LOGISTIC), 0.1)
+        return optim.lbfgs_solve(fun, jnp.zeros(X.shape[1]))
+
+    batched = jax.vmap(solve_one)(Xs, ys)
+    for i in range(B):
+        single = solve_one(Xs[i], ys[i])
+        np.testing.assert_allclose(
+            batched.coefficients[i], single.coefficients, rtol=1e-4, atol=1e-5)
+        assert int(batched.convergence_reason[i]) != 0
+
+
+def test_jit_compatible(quad):
+    A, b, w_star = quad
+    jitted = jax.jit(lambda w0: optim.lbfgs_solve(quad_fun(A, b), w0))
+    res = jitted(jnp.zeros_like(b))
+    np.testing.assert_allclose(res.coefficients, w_star, rtol=1e-5, atol=1e-6)
+
+
+def test_tron_vmap(rng):
+    B, n, d = 4, 30, 4
+    Xs = jnp.asarray(rng.normal(size=(B, n, d)))
+    ys = jnp.asarray((rng.uniform(size=(B, n)) > 0.5).astype(float))
+
+    def solve_one(X, y):
+        fun = optim.with_l2(glm_fun(X, y, losses.LOGISTIC), 0.3)
+        hvp = optim.with_l2_hvp(glm_hvp(X, y, losses.LOGISTIC), 0.3)
+        return optim.tron_solve(fun, hvp, jnp.zeros(X.shape[1]),
+                                optim.OptimizerConfig.tron())
+
+    batched = jax.vmap(solve_one)(Xs, ys)
+    for i in range(B):
+        single = solve_one(Xs[i], ys[i])
+        np.testing.assert_allclose(
+            batched.coefficients[i], single.coefficients, rtol=1e-4, atol=1e-5)
