@@ -1,0 +1,91 @@
+"""Feature index maps: feature name/term key <-> dense column index.
+
+TPU-native counterpart of the reference's IndexMap hierarchy
+(photon-api index/IndexMap.scala:54, DefaultIndexMap.scala:27,
+IdentityIndexMapLoader.scala:24) and the off-heap PalDBIndexMap
+(index/PalDBIndexMap.scala:43). The PalDB machinery exists because Spark
+executors must each hold the map off-heap; on a TPU host a plain dict (plus
+an Arrow-style persisted vocab file) covers the same >200k-feature regime,
+so there is one in-memory implementation with save/load.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from photon_tpu.types import INTERCEPT_KEY, FeatureKey
+
+
+class IndexMap:
+    """Bidirectional feature key <-> index map for one feature shard."""
+
+    def __init__(self, name_to_index: dict[FeatureKey, int]):
+        self._forward = dict(name_to_index)
+        self._backward = {i: n for n, i in self._forward.items()}
+        if len(self._backward) != len(self._forward):
+            raise ValueError("index map has duplicate indices")
+
+    # -- reference IndexMap trait surface -----------------------------------
+
+    def get_index(self, name: FeatureKey) -> int | None:
+        return self._forward.get(name)
+
+    def get_feature_name(self, index: int) -> FeatureKey | None:
+        return self._backward.get(index)
+
+    def __len__(self) -> int:
+        return len(self._forward)
+
+    def __contains__(self, name: FeatureKey) -> bool:
+        return name in self._forward
+
+    def items(self):
+        return self._forward.items()
+
+    @property
+    def has_intercept(self) -> bool:
+        return INTERCEPT_KEY in self._forward
+
+    @property
+    def intercept_index(self) -> int | None:
+        return self._forward.get(INTERCEPT_KEY)
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def from_feature_names(
+        names, *, add_intercept: bool = True
+    ) -> "IndexMap":
+        """Build deterministically from a collection of feature keys.
+
+        Reference: DefaultIndexMapLoader scans the data for distinct keys and
+        zips them with indices; we sort for run-to-run determinism, then
+        append the intercept last (the reference also treats the intercept as
+        just another feature key added during ingest).
+        """
+        uniq = sorted(set(names) - {INTERCEPT_KEY})
+        mapping = {n: i for i, n in enumerate(uniq)}
+        if add_intercept:
+            mapping[INTERCEPT_KEY] = len(mapping)
+        return IndexMap(mapping)
+
+    @staticmethod
+    def identity(num_features: int, *, add_intercept: bool = False) -> "IndexMap":
+        """Pre-indexed data (libsvm-style): name == str(index).
+
+        Reference: IdentityIndexMapLoader.scala:24.
+        """
+        mapping: dict[FeatureKey, int] = {str(i): i for i in range(num_features)}
+        if add_intercept:
+            mapping[INTERCEPT_KEY] = num_features
+        return IndexMap(mapping)
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(self._forward))
+
+    @staticmethod
+    def load(path: str | Path) -> "IndexMap":
+        return IndexMap(json.loads(Path(path).read_text()))

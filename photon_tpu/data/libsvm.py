@@ -1,0 +1,64 @@
+"""libsvm text ingest -> GLMBatch.
+
+Counterpart of the reference's deprecated libsvm input path
+(photon-client io/deprecated, used by the legacy Driver for the a9a fixture)
+— kept first-class here because it is the fastest route to standard GLM
+benchmark datasets.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from photon_tpu.data.dataset import GLMBatch, make_sparse_batch
+
+
+def read_libsvm(
+    path: str | Path,
+    *,
+    num_features: int | None = None,
+    add_intercept: bool = True,
+    binary_labels_to01: bool = True,
+    dtype=np.float32,
+) -> GLMBatch:
+    """Read a libsvm file into a padded-sparse batch.
+
+    libsvm indices are 1-based; they land at column (idx-1). With
+    ``add_intercept`` an all-ones column is appended at index d-1.
+    Labels -1/+1 are mapped to 0/1 when ``binary_labels_to01``.
+    """
+    labels: list[float] = []
+    rows: list[list[tuple[int, float]]] = []
+    max_idx = -1
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        labels.append(float(parts[0]))
+        row = []
+        for tok in parts[1:]:
+            if tok.startswith("#"):
+                break
+            k, v = tok.split(":")
+            idx = int(k) - 1
+            if idx < 0:
+                raise ValueError(f"libsvm index must be >= 1, got {k}")
+            max_idx = max(max_idx, idx)
+            row.append((idx, float(v)))
+        rows.append(row)
+
+    base = num_features if num_features is not None else max_idx + 1
+    if base <= max_idx:
+        raise ValueError(f"num_features={base} but saw index {max_idx}")
+    d = base + (1 if add_intercept else 0)
+    if add_intercept:
+        for row in rows:
+            row.append((d - 1, 1.0))
+
+    y = np.asarray(labels, dtype=dtype)
+    if binary_labels_to01 and y.min() < 0:
+        y = (y > 0).astype(dtype)
+    return make_sparse_batch(rows, d, y, dtype=dtype)

@@ -1,0 +1,137 @@
+"""Deterministic synthetic dataset generators for tests and benchmarks.
+
+Counterpart of the reference's SparkTestUtils generators
+(photon-test-utils test/SparkTestUtils.scala:85-200: seeded balanced binary /
+Poisson / linear datasets) and GameTestUtils (synthetic fixed/random-effect
+datasets). All generators are seeded and return host numpy, so tests can
+derive oracles before device transfer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_tpu.data.dataset import GLMBatch, make_dense_batch
+
+
+def _features(rng: np.random.Generator, n: int, d: int, intercept: bool) -> np.ndarray:
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    if intercept:
+        x[:, -1] = 1.0
+    return x
+
+
+def generate_linear(
+    seed: int, n: int, d: int, *, noise: float = 0.1, intercept: bool = True
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (X, y, w_true) for y = Xw + noise."""
+    rng = np.random.default_rng(seed)
+    x = _features(rng, n, d, intercept)
+    w = rng.normal(size=d)
+    y = x @ w + noise * rng.normal(size=n)
+    return x, y, w
+
+
+def generate_binary(
+    seed: int, n: int, d: int, *, intercept: bool = True
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (X, y01, w_true) with y ~ Bernoulli(sigmoid(Xw))."""
+    rng = np.random.default_rng(seed)
+    x = _features(rng, n, d, intercept)
+    w = rng.normal(size=d)
+    p = 1.0 / (1.0 + np.exp(-(x @ w)))
+    y = (rng.uniform(size=n) < p).astype(np.float64)
+    return x, y, w
+
+
+def generate_poisson(
+    seed: int, n: int, d: int, *, intercept: bool = True, scale: float = 0.5
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (X, counts, w_true) with y ~ Poisson(exp(Xw)); w scaled to
+    keep rates benign (the reference's 'numerically benign' variant)."""
+    rng = np.random.default_rng(seed)
+    x = _features(rng, n, d, intercept)
+    w = scale * rng.normal(size=d) / np.sqrt(d)
+    y = rng.poisson(np.exp(x @ w)).astype(np.float64)
+    return x, y, w
+
+
+def linear_batch(seed: int, n: int, d: int, **kw) -> GLMBatch:
+    x, y, _ = generate_linear(seed, n, d, **kw)
+    return make_dense_batch(x, y)
+
+
+def binary_batch(seed: int, n: int, d: int, **kw) -> GLMBatch:
+    x, y, _ = generate_binary(seed, n, d, **kw)
+    return make_dense_batch(x, y)
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticGameData:
+    """A GLMix-style problem: global features + per-entity memberships.
+
+    ``entity_ids[re_type]`` gives each row's entity code for that
+    random-effect type; ``re_features[re_type]`` the per-type feature matrix
+    (the feature shard that type's per-entity models train on).
+    """
+
+    x_global: np.ndarray  # [n, d_global]
+    labels: np.ndarray  # [n]
+    entity_ids: dict[str, np.ndarray]  # re_type -> [n] int codes
+    re_features: dict[str, np.ndarray]  # re_type -> [n, d_re]
+    w_global: np.ndarray
+    re_models: dict[str, np.ndarray]  # re_type -> [num_entities, d_re]
+
+
+def generate_game_data(
+    seed: int,
+    n: int,
+    d_global: int,
+    re_specs: dict[str, tuple[int, int]],
+    *,
+    task: str = "linear",
+    noise: float = 0.1,
+    entity_skew: float = 1.2,
+) -> SyntheticGameData:
+    """GLMix generator: score = x.w_global + sum_t x_t.w_t[entity_t(row)].
+
+    ``re_specs`` maps re_type -> (num_entities, d_re). Entity membership is
+    zipf-ish (power-law sized entities, the regime the reference's
+    partitioner bin-packs around, RandomEffectDatasetPartitioner.scala:44).
+    """
+    rng = np.random.default_rng(seed)
+    x_global = _features(rng, n, d_global, True)
+    w_global = rng.normal(size=d_global)
+    score = x_global @ w_global
+
+    entity_ids: dict[str, np.ndarray] = {}
+    re_features: dict[str, np.ndarray] = {}
+    re_models: dict[str, np.ndarray] = {}
+    for re_type, (num_entities, d_re) in re_specs.items():
+        probs = (1.0 / np.arange(1, num_entities + 1) ** entity_skew)
+        probs /= probs.sum()
+        ids = rng.choice(num_entities, size=n, p=probs)
+        xt = _features(rng, n, d_re, True)
+        wt = 0.5 * rng.normal(size=(num_entities, d_re))
+        entity_ids[re_type] = ids
+        re_features[re_type] = xt
+        re_models[re_type] = wt
+        score = score + np.einsum("nd,nd->n", xt, wt[ids])
+
+    if task == "linear":
+        labels = score + noise * rng.normal(size=n)
+    elif task == "logistic":
+        labels = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-score))).astype(np.float64)
+    else:
+        raise ValueError(f"unknown task {task!r}")
+
+    return SyntheticGameData(
+        x_global=x_global,
+        labels=labels,
+        entity_ids=entity_ids,
+        re_features=re_features,
+        w_global=w_global,
+        re_models=re_models,
+    )

@@ -1,0 +1,95 @@
+"""Data layer: libsvm ingest, index maps, ELL packing, synthetic generators."""
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import SparseFeatures, rows_to_ell
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.data.libsvm import read_libsvm
+from photon_tpu.data.synthetic import generate_binary, generate_game_data
+from photon_tpu.types import INTERCEPT_KEY
+
+
+def test_libsvm_round_trip(tmp_path):
+    content = """\
++1 1:0.5 3:-1.25
+-1 2:2.0
++1 1:1.0 2:1.0 3:1.0
+"""
+    p = tmp_path / "tiny.libsvm"
+    p.write_text(content)
+    batch = read_libsvm(p)
+    # 3 features + intercept
+    assert batch.num_features == 4
+    assert batch.num_samples == 3
+    np.testing.assert_array_equal(batch.labels, [1.0, 0.0, 1.0])
+    feats = batch.features
+    assert isinstance(feats, SparseFeatures)
+    dense = np.zeros((3, 4))
+    for i in range(3):
+        for j in range(feats.indices.shape[1]):
+            dense[i, int(feats.indices[i, j])] += float(feats.values[i, j])
+    np.testing.assert_allclose(
+        dense,
+        [[0.5, 0.0, -1.25, 1.0], [0.0, 2.0, 0.0, 1.0], [1.0, 1.0, 1.0, 1.0]],
+    )
+
+
+def test_libsvm_num_features_override(tmp_path):
+    p = tmp_path / "t.libsvm"
+    p.write_text("1 1:1.0\n")
+    batch = read_libsvm(p, num_features=10, add_intercept=False)
+    assert batch.num_features == 10
+    with pytest.raises(ValueError):
+        read_libsvm(p, num_features=0, add_intercept=False)
+
+
+def test_index_map_from_names():
+    im = IndexMap.from_feature_names(["b", "a", "c", "a"])
+    assert len(im) == 4  # 3 + intercept
+    assert im.get_index("a") == 0 and im.get_index("c") == 2
+    assert im.intercept_index == 3
+    assert im.get_feature_name(0) == "a"
+    assert "missing" not in im
+
+
+def test_index_map_identity_and_save_load(tmp_path):
+    im = IndexMap.identity(5, add_intercept=True)
+    assert im.get_index("3") == 3
+    assert im.intercept_index == 5
+    path = tmp_path / "vocab.json"
+    im.save(path)
+    im2 = IndexMap.load(path)
+    assert im2.get_index(INTERCEPT_KEY) == 5
+    assert len(im2) == len(im)
+
+
+def test_rows_to_ell_validation():
+    with pytest.raises(ValueError):
+        rows_to_ell([[(5, 1.0)]], num_features=3)
+    with pytest.raises(ValueError):
+        rows_to_ell([[(0, 1.0), (1, 1.0)]], num_features=3, capacity=1)
+    idx, val = rows_to_ell([[(0, 1.0)], []], num_features=3)
+    assert idx.shape == (2, 1)
+    assert val[1, 0] == 0.0
+
+
+def test_generators_deterministic():
+    x1, y1, w1 = generate_binary(7, 50, 4)
+    x2, y2, w2 = generate_binary(7, 50, 4)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+    assert np.all(x1[:, -1] == 1.0)  # intercept column
+
+
+def test_game_data_generator():
+    data = generate_game_data(
+        3, 200, 5, {"user": (20, 3), "item": (10, 4)}, task="linear")
+    assert data.x_global.shape == (200, 5)
+    assert set(data.entity_ids) == {"user", "item"}
+    assert data.re_models["user"].shape == (20, 3)
+    assert data.re_features["item"].shape == (200, 4)
+    assert data.entity_ids["user"].max() < 20
+    # power-law skew: most common entity should dominate
+    counts = np.bincount(data.entity_ids["user"], minlength=20)
+    assert counts[0] == counts.max()

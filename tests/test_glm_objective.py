@@ -1,0 +1,167 @@
+"""GLM objective: autodiff oracles, sparse/dense parity, sharded parity.
+
+Replaces the reference's aggregator unit tests
+(ValueAndGradientAggregator/HessianVectorAggregator tests) with autodiff as
+the oracle and an 8-device sharded-vs-local equivalence check standing in for
+Spark local-mode integration tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import (
+    DenseFeatures,
+    GLMBatch,
+    make_dense_batch,
+    make_sparse_batch,
+    pad_batch,
+)
+from photon_tpu.ops import glm, losses
+from photon_tpu.ops.normalization import NormalizationType, build_normalization_context
+from photon_tpu.parallel.mesh import make_mesh, shard_batch
+
+
+def _random_problem(rng, n=40, d=7, density=0.4):
+    mask = rng.uniform(size=(n, d)) < density
+    x = np.where(mask, rng.normal(size=(n, d)), 0.0)
+    x[:, -1] = 1.0  # intercept
+    y = (rng.uniform(size=n) > 0.5).astype(float)
+    offsets = rng.normal(size=n) * 0.3
+    weights = rng.uniform(0.5, 2.0, size=n)
+    return x, y, offsets, weights
+
+
+def _sparse_rows(x):
+    return [
+        [(j, float(v)) for j, v in enumerate(row) if v != 0.0] for row in x
+    ]
+
+
+@pytest.fixture
+def problem(rng):
+    return _random_problem(rng)
+
+
+@pytest.fixture
+def norm_ctx(problem):
+    x, *_ = problem
+    return build_normalization_context(
+        NormalizationType.STANDARDIZATION,
+        mean=jnp.asarray(x.mean(0)),
+        variance=jnp.asarray(x.var(0) + 0.1),
+        intercept_index=x.shape[1] - 1,
+    )
+
+
+@pytest.mark.parametrize("loss", [losses.LOGISTIC, losses.SQUARED, losses.POISSON],
+                         ids=lambda l: l.name)
+@pytest.mark.parametrize("use_norm", [False, True], ids=["raw", "standardized"])
+def test_gradient_matches_autodiff(problem, norm_ctx, loss, use_norm, rng):
+    x, y, offsets, weights = problem
+    batch = make_dense_batch(x, y, offsets, weights, dtype=jnp.float64)
+    norm = norm_ctx if use_norm else None
+    fun = glm.make_value_and_grad(batch, loss, norm)
+    w = jnp.asarray(rng.normal(size=x.shape[1]) * 0.3)
+    f, g = fun(w)
+    auto = jax.grad(lambda w: fun(w)[0])(w)
+    np.testing.assert_allclose(g, auto, rtol=1e-9, atol=1e-11)
+
+
+def test_sparse_dense_parity(problem, norm_ctx, rng):
+    x, y, offsets, weights = problem
+    dense = make_dense_batch(x, y, offsets, weights, dtype=jnp.float64)
+    sparse = make_sparse_batch(_sparse_rows(x), x.shape[1], y, offsets, weights,
+                               dtype=jnp.float64)
+    w = jnp.asarray(rng.normal(size=x.shape[1]))
+    for norm in (None, norm_ctx):
+        fd, gd = glm.make_value_and_grad(dense, losses.LOGISTIC, norm)(w)
+        fs, gs = glm.make_value_and_grad(sparse, losses.LOGISTIC, norm)(w)
+        np.testing.assert_allclose(fd, fs, rtol=1e-12)
+        np.testing.assert_allclose(gd, gs, rtol=1e-10, atol=1e-12)
+
+
+@pytest.mark.parametrize("use_norm", [False, True], ids=["raw", "standardized"])
+def test_hvp_matches_autodiff(problem, norm_ctx, use_norm, rng):
+    x, y, offsets, weights = problem
+    batch = make_dense_batch(x, y, offsets, weights, dtype=jnp.float64)
+    norm = norm_ctx if use_norm else None
+    fun = glm.make_value_and_grad(batch, losses.LOGISTIC, norm)
+    hvp = glm.make_hvp(batch, losses.LOGISTIC, norm)
+    w = jnp.asarray(rng.normal(size=x.shape[1]) * 0.3)
+    v = jnp.asarray(rng.normal(size=x.shape[1]))
+    got = hvp(w, v)
+    # For logistic loss the Gauss-Newton Hessian IS the true Hessian.
+    auto = jax.jvp(lambda w: fun(w)[1], (w,), (v,))[1]
+    np.testing.assert_allclose(got, auto, rtol=1e-8, atol=1e-10)
+
+
+@pytest.mark.parametrize("sparse", [False, True], ids=["dense", "sparse"])
+@pytest.mark.parametrize("use_norm", [False, True], ids=["raw", "standardized"])
+def test_hessian_diag_and_matrix(problem, norm_ctx, use_norm, sparse, rng):
+    x, y, offsets, weights = problem
+    if sparse:
+        batch = make_sparse_batch(_sparse_rows(x), x.shape[1], y, offsets,
+                                  weights, dtype=jnp.float64)
+    else:
+        batch = make_dense_batch(x, y, offsets, weights, dtype=jnp.float64)
+    norm = norm_ctx if use_norm else None
+    w = jnp.asarray(rng.normal(size=x.shape[1]) * 0.3)
+    H = glm.hessian_matrix(batch, losses.LOGISTIC, w, norm)
+    hvp = glm.make_hvp(batch, losses.LOGISTIC, norm)
+    # H column parity with HVP on basis vectors
+    eye = jnp.eye(x.shape[1], dtype=jnp.float64)
+    H_cols = jax.vmap(lambda e: hvp(w, e))(eye).T
+    np.testing.assert_allclose(H, H_cols, rtol=1e-8, atol=1e-10)
+    # diag parity
+    np.testing.assert_allclose(
+        glm.hessian_diagonal(batch, losses.LOGISTIC, w, norm),
+        jnp.diagonal(H), rtol=1e-8, atol=1e-10)
+
+
+def test_weight_zero_rows_are_inert(problem, rng):
+    x, y, offsets, weights = problem
+    batch = make_dense_batch(x, y, offsets, weights, dtype=jnp.float64)
+    padded = pad_batch(batch, 16)
+    assert padded.num_samples % 16 == 0
+    w = jnp.asarray(rng.normal(size=x.shape[1]))
+    f1, g1 = glm.make_value_and_grad(batch, losses.LOGISTIC)(w)
+    f2, g2 = glm.make_value_and_grad(padded, losses.LOGISTIC)(w)
+    np.testing.assert_allclose(f1, f2, rtol=1e-12)
+    np.testing.assert_allclose(g1, g2, rtol=1e-12)
+
+
+def test_sharded_objective_matches_local(problem, rng):
+    """8-virtual-device parity: the distributed execution mode."""
+    x, y, offsets, weights = problem
+    batch = make_dense_batch(x, y, offsets, weights, dtype=jnp.float64)
+    mesh = make_mesh()
+    sharded = shard_batch(batch, mesh)
+    w = jnp.asarray(rng.normal(size=x.shape[1]))
+
+    f_local, g_local = glm.make_value_and_grad(batch, losses.LOGISTIC)(w)
+    fun = jax.jit(lambda w: glm.make_value_and_grad(sharded, losses.LOGISTIC)(w))
+    f_shard, g_shard = fun(w)
+    np.testing.assert_allclose(f_local, f_shard, rtol=1e-12)
+    np.testing.assert_allclose(g_local, g_shard, rtol=1e-12)
+    # the compiled program really ran on 8 shards
+    assert len(sharded.labels.sharding.device_set) == 8
+
+
+def test_end_to_end_sharded_solve_matches_local(problem, rng):
+    from photon_tpu import optim
+
+    x, y, offsets, weights = problem
+    batch = make_dense_batch(x, y, offsets, weights, dtype=jnp.float64)
+    mesh = make_mesh()
+    sharded = shard_batch(batch, mesh)
+
+    def solve(b):
+        fun = optim.with_l2(glm.make_value_and_grad(b, losses.LOGISTIC), 0.5)
+        return optim.lbfgs_solve(fun, jnp.zeros(x.shape[1], dtype=jnp.float64))
+
+    r_local = solve(batch)
+    r_shard = jax.jit(lambda: solve(sharded))()
+    np.testing.assert_allclose(
+        r_shard.coefficients, r_local.coefficients, rtol=1e-8, atol=1e-10)
