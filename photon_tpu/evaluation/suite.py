@@ -1,0 +1,128 @@
+"""EvaluationSuite: bundle of evaluators over one validation dataset.
+
+TPU-native counterpart of photon-lib evaluation/EvaluationSuite.scala:59-90
+and EvaluationResults.scala. The reference left-joins label/offset/weight
+RDDs with score RDDs; here validation rows live in fixed canonical order, so
+evaluation is elementwise: evaluated score = model score + offset
+(EvaluationSuite.scala:62-66).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.evaluation.evaluators import (
+    EvaluatorSpec,
+    evaluate_single,
+    grouped_auc,
+    grouped_precision_at_k,
+)
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class EvaluationResults:
+    """Reference: evaluation/EvaluationResults.scala."""
+
+    evaluations: dict[str, float]
+    primary_evaluator: EvaluatorSpec
+
+    @property
+    def primary_evaluation(self) -> float:
+        return self.evaluations[self.primary_evaluator.name]
+
+
+@dataclasses.dataclass(frozen=True)
+class EvaluationSuite:
+    """Evaluators + the validation data columns they run against.
+
+    ``group_ids`` maps an id tag name (e.g. "queryId") to integer group codes
+    aligned with the label rows; tags are produced by ingest (the reference
+    extracts them from GameDatum.idTagToValueMap).
+    The first spec is the primary evaluator used for model selection
+    (EvaluationSuite primaryEvaluator).
+    """
+
+    specs: tuple[EvaluatorSpec, ...]
+    labels: Array
+    offsets: Array
+    weights: Array
+    group_ids: dict[str, tuple[Array, int]] = dataclasses.field(
+        default_factory=dict)
+
+    def __post_init__(self):
+        if not self.specs:
+            raise ValueError("EvaluationSuite needs at least one evaluator")
+        for spec in self.specs:
+            if spec.group_tag is not None and spec.group_tag not in self.group_ids:
+                raise ValueError(
+                    f"evaluator {spec.name} needs id tag {spec.group_tag!r}, "
+                    f"got {sorted(self.group_ids)}")
+
+    @property
+    def primary(self) -> EvaluatorSpec:
+        return self.specs[0]
+
+    def evaluate(self, scores: Array) -> EvaluationResults:
+        z = scores + self.offsets
+        out: dict[str, float] = {}
+        for spec in self.specs:
+            if spec.group_tag is not None:
+                codes, num_groups = self.group_ids[spec.group_tag]
+                if spec.precision_k is not None:
+                    val = grouped_precision_at_k(
+                        z, self.labels, codes, num_groups, spec.precision_k)
+                else:
+                    assert spec.evaluator_type is not None
+                    if spec.evaluator_type.value != "AUC":
+                        raise NotImplementedError(
+                            f"grouped {spec.evaluator_type} not supported "
+                            "(reference MultiEvaluator supports AUC and "
+                            "precision@k)")
+                    val = grouped_auc(z, self.labels, codes, num_groups,
+                                      self.weights)
+            else:
+                assert spec.evaluator_type is not None
+                val = evaluate_single(spec.evaluator_type, z, self.labels,
+                                      self.weights)
+            out[spec.name] = float(val)
+        return EvaluationResults(out, self.primary)
+
+
+def make_suite(
+    specs: list[str | EvaluatorSpec],
+    labels,
+    offsets=None,
+    weights=None,
+    group_ids: dict[str, tuple[Array, int]] | None = None,
+    dtype=jnp.float64,
+) -> EvaluationSuite:
+    labels = jnp.asarray(labels, dtype=dtype)
+    n = labels.shape[0]
+    parsed = tuple(
+        s if isinstance(s, EvaluatorSpec) else EvaluatorSpec.parse(s)
+        for s in specs
+    )
+    return EvaluationSuite(
+        specs=parsed,
+        labels=labels,
+        offsets=jnp.zeros(n, dtype) if offsets is None else jnp.asarray(offsets, dtype),
+        weights=jnp.ones(n, dtype) if weights is None else jnp.asarray(weights, dtype),
+        group_ids=group_ids or {},
+    )
+
+
+def encode_group_ids(raw_ids) -> tuple[Array, int, dict]:
+    """Host-side: map arbitrary group keys to dense int codes.
+
+    Returns (codes [n] int32, num_groups, key->code vocab).
+    """
+    raw = np.asarray(raw_ids)
+    uniq, codes = np.unique(raw, return_inverse=True)
+    vocab = {k.item() if hasattr(k, "item") else k: i for i, k in enumerate(uniq)}
+    return jnp.asarray(codes.astype(np.int32)), len(uniq), vocab

@@ -1,0 +1,113 @@
+"""GLM model objects: coefficients and task-typed generalized linear models.
+
+TPU-native counterpart of the reference's model layer:
+``Coefficients`` (photon-lib model/Coefficients.scala:31, computeScore :51),
+``GeneralizedLinearModel`` and its task-specific subclasses
+(photon-api supervised/model/GeneralizedLinearModel.scala:33,
+LogisticRegressionModel.scala:31 — mean = sigmoid,
+PoissonRegressionModel — mean = exp, LinearRegressionModel,
+SmoothedHingeLossLinearSVMModel; ``BinaryClassifier`` trait :23).
+
+The Scala subclass hierarchy collapses to one pytree dataclass carrying a
+``TaskType``: the link function and loss are looked up from the task, and the
+model flows through jit as data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.dataset import Features, GLMBatch
+from photon_tpu.ops import losses as losses_mod
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    """Means + optional variances of model coefficients.
+
+    Reference: model/Coefficients.scala:31. Variances appear when variance
+    computation is enabled (SIMPLE/FULL) and feed incremental training's
+    Gaussian prior.
+    """
+
+    means: Array  # [d]
+    variances: Array | None = None  # [d]
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, features: Features) -> Array:
+        """x . w for a batch of rows (Coefficients.computeScore :51)."""
+        return features.matvec(self.means)
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(means=jnp.zeros(dim, dtype=dtype))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """A task-typed GLM.
+
+    ``score`` is the linear margin; ``mean`` applies the inverse link
+    (sigmoid / identity / exp); ``predict_class`` thresholds binary tasks
+    (BinaryClassifier.predictClassWithThreshold semantics).
+    """
+
+    coefficients: Coefficients
+    task: TaskType = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def loss(self) -> losses_mod.PointwiseLoss:
+        return losses_mod.get_loss(self.task)
+
+    def compute_score(self, features: Features, offsets: Array | None = None) -> Array:
+        z = self.coefficients.compute_score(features)
+        return z if offsets is None else z + offsets
+
+    def compute_mean(self, features: Features, offsets: Array | None = None) -> Array:
+        """E[y | x] via the inverse link (GeneralizedLinearModel.computeMean)."""
+        return self.loss.mean(self.compute_score(features, offsets))
+
+    def predict_class(
+        self, features: Features, offsets: Array | None = None, threshold: float = 0.5
+    ) -> Array:
+        if self.task not in (
+            TaskType.LOGISTIC_REGRESSION,
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        ):
+            raise ValueError(f"{self.task} is not a binary classification task")
+        if self.task == TaskType.LOGISTIC_REGRESSION:
+            return (self.compute_mean(features, offsets) > threshold).astype(jnp.int32)
+        # SVM: sign of the margin
+        return (self.compute_score(features, offsets) > 0.0).astype(jnp.int32)
+
+    def update_coefficients(self, coefficients: Coefficients) -> "GeneralizedLinearModel":
+        """Reference: GeneralizedLinearModel.updateCoefficients."""
+        return dataclasses.replace(self, coefficients=coefficients)
+
+
+def logistic_regression(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(coefficients, TaskType.LOGISTIC_REGRESSION)
+
+
+def linear_regression(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(coefficients, TaskType.LINEAR_REGRESSION)
+
+
+def poisson_regression(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(coefficients, TaskType.POISSON_REGRESSION)
+
+
+def smoothed_hinge_svm(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(
+        coefficients, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)

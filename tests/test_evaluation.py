@@ -1,0 +1,141 @@
+"""Evaluation metrics vs sklearn oracles and hand-computed fixtures."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from photon_tpu.evaluation import evaluators as ev
+from photon_tpu.evaluation.suite import encode_group_ids, make_suite
+
+
+@pytest.fixture
+def scored(rng):
+    n = 400
+    labels = (rng.uniform(size=n) > 0.6).astype(float)
+    scores = labels * 0.8 + rng.normal(size=n)  # informative but noisy
+    return jnp.asarray(scores), jnp.asarray(labels)
+
+
+def test_auc_vs_sklearn(scored):
+    s, y = scored
+    got = float(ev.auc_roc(s, y))
+    want = skm.roc_auc_score(np.asarray(y), np.asarray(s))
+    assert got == pytest.approx(want, abs=1e-12)
+
+
+def test_auc_with_ties_vs_sklearn(rng):
+    y = (rng.uniform(size=300) > 0.5).astype(float)
+    s = np.round(y + rng.normal(size=300), 1)  # heavy ties
+    got = float(ev.auc_roc(jnp.asarray(s), jnp.asarray(y)))
+    want = skm.roc_auc_score(y, s)
+    assert got == pytest.approx(want, abs=1e-12)
+
+
+def test_weighted_auc_vs_sklearn(rng):
+    y = (rng.uniform(size=200) > 0.5).astype(float)
+    s = y + rng.normal(size=200)
+    w = rng.uniform(0.1, 3.0, size=200)
+    got = float(ev.auc_roc(jnp.asarray(s), jnp.asarray(y), jnp.asarray(w)))
+    want = skm.roc_auc_score(y, s, sample_weight=w)
+    assert got == pytest.approx(want, abs=1e-10)
+
+
+def test_auc_single_class_is_nan():
+    assert np.isnan(float(ev.auc_roc(jnp.asarray([0.1, 0.2]), jnp.asarray([1.0, 1.0]))))
+
+
+def test_aupr_close_to_sklearn(scored):
+    s, y = scored
+    got = float(ev.auc_pr(s, y))
+    # sklearn's PR curve + trapezoid (same construction as Spark's metric,
+    # modulo the left anchor point; tolerance covers it)
+    prec, rec, _ = skm.precision_recall_curve(np.asarray(y), np.asarray(s))
+    want = float(skm.auc(rec[::-1], prec[::-1]))
+    assert got == pytest.approx(want, rel=5e-3)
+
+
+def test_rmse_reference_formula(rng):
+    y = rng.normal(size=50)
+    s = y + rng.normal(size=50)
+    w = rng.uniform(0.5, 2.0, size=50)
+    got = float(ev.rmse(jnp.asarray(s), jnp.asarray(y), jnp.asarray(w)))
+    want = np.sqrt(np.sum(w * (s - y) ** 2 / 2) / 50)  # reference quirk: /2 inside
+    assert got == pytest.approx(want, rel=1e-12)
+
+
+def test_loss_evaluators_are_weighted_sums(rng):
+    y = (rng.uniform(size=30) > 0.5).astype(float)
+    s = rng.normal(size=30)
+    w = rng.uniform(0.5, 2.0, size=30)
+    got = float(ev.logistic_loss(jnp.asarray(s), jnp.asarray(y), jnp.asarray(w)))
+    want = np.sum(w * (np.log1p(np.exp(-np.abs(s))) + np.maximum(s, 0) - s * y))
+    assert got == pytest.approx(want, rel=1e-10)
+
+
+def test_grouped_auc_matches_loop(rng):
+    n, g = 500, 12
+    gids = rng.integers(0, g, size=n)
+    y = (rng.uniform(size=n) > 0.5).astype(float)
+    s = y * 0.6 + rng.normal(size=n)
+    got = float(ev.grouped_auc(jnp.asarray(s), jnp.asarray(y),
+                               jnp.asarray(gids.astype(np.int32)), g))
+    per = []
+    for i in range(g):
+        m = gids == i
+        if len(np.unique(y[m])) == 2:
+            per.append(skm.roc_auc_score(y[m], s[m]))
+    assert got == pytest.approx(np.mean(per), abs=1e-10)
+
+
+def test_grouped_precision_at_k_matches_loop(rng):
+    n, g, k = 300, 10, 5
+    gids = rng.integers(0, g, size=n)
+    y = (rng.uniform(size=n) > 0.5).astype(float)
+    s = rng.normal(size=n)
+    got = float(ev.grouped_precision_at_k(
+        jnp.asarray(s), jnp.asarray(y), jnp.asarray(gids.astype(np.int32)), g, k))
+    per = []
+    for i in range(g):
+        m = gids == i
+        order = np.argsort(-s[m], kind="stable")
+        per.append(np.sum(y[m][order][:k] > 0.5) / k)
+    assert got == pytest.approx(np.mean(per), abs=1e-10)
+
+
+def test_evaluator_spec_parse():
+    spec = ev.EvaluatorSpec.parse("PRECISION@5:queryId")
+    assert spec.precision_k == 5 and spec.group_tag == "queryId"
+    assert spec.name == "PRECISION@5:queryId"
+    spec2 = ev.EvaluatorSpec.parse("AUC:userId")
+    assert spec2.evaluator_type == ev.EvaluatorType.AUC
+    spec3 = ev.EvaluatorSpec.parse("rmse")
+    assert spec3.evaluator_type == ev.EvaluatorType.RMSE
+    assert not spec3.bigger_is_better and spec2.bigger_is_better
+
+
+def test_suite_end_to_end(rng):
+    n = 200
+    y = (rng.uniform(size=n) > 0.5).astype(float)
+    scores = y + rng.normal(size=n)
+    offsets = rng.normal(size=n) * 0.1
+    qids = rng.integers(0, 7, size=n)
+    codes, num_groups, _ = encode_group_ids(qids)
+    suite = make_suite(
+        ["AUC", "LOGISTIC_LOSS", "PRECISION@3:queryId", "AUC:queryId"],
+        y, offsets=offsets,
+        group_ids={"queryId": (codes, num_groups)},
+    )
+    res = suite.evaluate(jnp.asarray(scores))
+    assert set(res.evaluations) == {
+        "AUC", "LOGISTIC_LOSS", "PRECISION@3:queryId", "AUC:queryId"}
+    # offsets really participate
+    want = skm.roc_auc_score(y, scores + offsets)
+    assert res.evaluations["AUC"] == pytest.approx(want, abs=1e-12)
+    assert res.primary_evaluator.name == "AUC"
+    assert res.primary_evaluation == res.evaluations["AUC"]
+
+
+def test_suite_rejects_missing_tag(rng):
+    with pytest.raises(ValueError):
+        make_suite(["AUC:queryId"], np.zeros(5))

@@ -1,0 +1,63 @@
+"""GLM model objects: scoring, link functions, classification."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import DenseFeatures
+from photon_tpu.models.glm import (
+    Coefficients,
+    linear_regression,
+    logistic_regression,
+    poisson_regression,
+    smoothed_hinge_svm,
+)
+from photon_tpu.types import TaskType
+
+
+def test_score_and_mean(rng):
+    x = rng.normal(size=(20, 4))
+    w = rng.normal(size=4)
+    feats = DenseFeatures(jnp.asarray(x))
+    coef = Coefficients(jnp.asarray(w))
+    m = logistic_regression(coef)
+    np.testing.assert_allclose(m.compute_score(feats), x @ w, rtol=1e-12)
+    np.testing.assert_allclose(
+        m.compute_mean(feats), 1 / (1 + np.exp(-(x @ w))), rtol=1e-10)
+    p = poisson_regression(coef)
+    np.testing.assert_allclose(p.compute_mean(feats), np.exp(x @ w), rtol=1e-10)
+    lin = linear_regression(coef)
+    np.testing.assert_allclose(lin.compute_mean(feats), x @ w, rtol=1e-12)
+
+
+def test_offsets_added(rng):
+    x = rng.normal(size=(5, 3))
+    off = rng.normal(size=5)
+    m = linear_regression(Coefficients(jnp.asarray(rng.normal(size=3))))
+    feats = DenseFeatures(jnp.asarray(x))
+    np.testing.assert_allclose(
+        m.compute_score(feats, jnp.asarray(off)),
+        m.compute_score(feats) + off, rtol=1e-12)
+
+
+def test_predict_class(rng):
+    x = rng.normal(size=(50, 3))
+    w = rng.normal(size=3)
+    feats = DenseFeatures(jnp.asarray(x))
+    m = logistic_regression(Coefficients(jnp.asarray(w)))
+    np.testing.assert_array_equal(
+        np.asarray(m.predict_class(feats)), (x @ w > 0).astype(int))
+    svm = smoothed_hinge_svm(Coefficients(jnp.asarray(w)))
+    np.testing.assert_array_equal(
+        np.asarray(svm.predict_class(feats)), (x @ w > 0).astype(int))
+    with pytest.raises(ValueError):
+        linear_regression(Coefficients(jnp.asarray(w))).predict_class(feats)
+
+
+def test_model_is_pytree():
+    import jax
+
+    m = logistic_regression(Coefficients.zeros(3))
+    m2 = jax.tree.map(lambda a: a + 1.0, m)
+    assert m2.task == TaskType.LOGISTIC_REGRESSION
+    np.testing.assert_allclose(m2.coefficients.means, np.ones(3))
