@@ -1,0 +1,113 @@
+"""Coordinates: the trainable/scorable units of a GAME model.
+
+TPU-native counterpart of photon-lib algorithm/Coordinate.scala:28 (train
+with optional warm start / residual offsets, score) and photon-api
+algorithm/FixedEffectCoordinate.scala:33. The random-effect coordinate lives
+in ``random_effect.py``; score-only (locked) coordinates are
+``ModelCoordinate`` equivalents.
+
+A coordinate's ``score`` returns the pure model contribution per row — the
+CoordinateDataScores used as residual offsets by coordinate descent
+(FixedEffectCoordinate.score :144-154 computes coefficient dot features with
+no offset added).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.algorithm.problems import (
+    GLMOptimizationConfiguration,
+    GLMOptimizationProblem,
+)
+from photon_tpu.data.dataset import GLMBatch
+from photon_tpu.data.sampling import downsample
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+class Coordinate(Protocol):
+    """Reference: algorithm/Coordinate.scala:28."""
+
+    def train(
+        self,
+        residuals: Array | None = None,
+        initial_model=None,
+        *,
+        seed: int = 0,
+    ):
+        """Fit against base offsets + residual scores; returns
+        (model, diagnostics)."""
+
+    def score(self, model) -> Array:
+        """Model contribution per row of the canonical table."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectCoordinate:
+    """Global GLM coordinate over one feature shard.
+
+    ``batch.offsets`` are the dataset's base offsets; residual scores from
+    other coordinates are added per train call (Coordinate.scala:52-53).
+    Optional negative down-sampling applies per train call with a fresh
+    seeded key (FixedEffectCoordinate.trainModel →
+    DistributedOptimizationProblem.runWithSampling :141-167).
+    """
+
+    batch: GLMBatch
+    problem: GLMOptimizationProblem
+
+    @property
+    def config(self) -> GLMOptimizationConfiguration:
+        return self.problem.config
+
+    def train(
+        self,
+        residuals: Array | None = None,
+        initial_model: GeneralizedLinearModel | None = None,
+        *,
+        seed: int = 0,
+    ):
+        batch = self.batch
+        if residuals is not None:
+            batch = batch.with_offsets(batch.offsets + residuals)
+        rate = self.config.down_sampling_rate
+        if 0.0 < rate < 1.0:
+            binary = self.problem.task in (
+                TaskType.LOGISTIC_REGRESSION,
+                TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+            )
+            batch = downsample(
+                batch, rate, jax.random.key(seed), binary=binary)
+        initial = initial_model.coefficients if initial_model is not None else None
+        solution = self.problem.run(batch, initial)
+        return solution.model, solution.result
+
+    def score(self, model: GeneralizedLinearModel) -> Array:
+        return model.coefficients.compute_score(self.batch.features)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCoordinate:
+    """Score-only coordinate for locked (partial-retrain) models.
+
+    Reference: algorithm/ModelCoordinate.scala:64,
+    FixedEffectModelCoordinate.scala:44.
+    """
+
+    inner: Coordinate
+    model: GeneralizedLinearModel
+
+    def train(self, residuals=None, initial_model=None, *, seed: int = 0):
+        raise RuntimeError(
+            "locked coordinate cannot be retrained "
+            "(partialRetrainLockedCoordinates)")
+
+    def score(self, model=None) -> Array:
+        return self.inner.score(self.model if model is None else model)

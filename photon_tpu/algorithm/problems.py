@@ -1,0 +1,195 @@
+"""Optimization problems: config dataclasses + solve/variance orchestration.
+
+TPU-native counterpart of:
+- ``GLMOptimizationConfiguration`` + coordinate optimization configs
+  (photon-api optimization/game/CoordinateOptimizationConfiguration.scala:113,
+  GLMOptimizationConfiguration.scala),
+- ``GeneralizedLinearOptimizationProblem`` / ``DistributedOptimizationProblem``
+  (optimization/GeneralizedLinearOptimizationProblem.scala:146,
+  optimization/DistributedOptimizationProblem.scala:46): zero-model init,
+  warm-start lambda updates, SIMPLE (inverse Hessian diagonal) and FULL
+  (inverse-Hessian diagonal via Cholesky) coefficient variances (:86-103),
+  and the transformed-space-optimize / original-space-report normalization
+  round trip (:124-132).
+
+``VarianceComputationType`` mirrors optimization/VarianceComputationType.scala.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu import optim
+from photon_tpu.data.dataset import GLMBatch
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.ops import glm as glm_ops
+from photon_tpu.ops import losses as losses_mod
+from photon_tpu.ops.normalization import NormalizationContext, no_normalization
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+class VarianceComputationType(enum.Enum):
+    NONE = "NONE"
+    SIMPLE = "SIMPLE"
+    FULL = "FULL"
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMOptimizationConfiguration:
+    """Optimizer + regularization + lambda for one coordinate.
+
+    Reference: GLMOptimizationConfiguration (optimizerConfig,
+    regularizationContext, regularizationWeight); FixedEffect adds
+    ``down_sampling_rate`` (FixedEffectOptimizationConfiguration).
+    """
+
+    optimizer: optim.OptimizerConfig = dataclasses.field(
+        default_factory=optim.OptimizerConfig)
+    regularization: optim.RegularizationContext = dataclasses.field(
+        default_factory=optim.RegularizationContext)
+    regularization_weight: float = 0.0
+    down_sampling_rate: float = 1.0
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE
+
+    def with_regularization_weight(self, weight: float) -> "GLMOptimizationConfiguration":
+        """Warm-start lambda update
+        (DistributedOptimizationProblem.updateRegularizationWeight :64)."""
+        return dataclasses.replace(self, regularization_weight=weight)
+
+    @property
+    def l1_weight(self) -> float:
+        return self.regularization.l1_weight(self.regularization_weight)
+
+    @property
+    def l2_weight(self) -> float:
+        return self.regularization.l2_weight(self.regularization_weight)
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMSolution:
+    """run() output: model in ORIGINAL feature space + solver diagnostics."""
+
+    model: GeneralizedLinearModel
+    result: optim.OptResult
+
+
+def compute_variances(
+    batch: GLMBatch,
+    loss: losses_mod.PointwiseLoss,
+    coef_transformed: Array,
+    norm: NormalizationContext,
+    l2_weight: float,
+    intercept_index: int | None,
+    variance_computation: VarianceComputationType,
+) -> Array | None:
+    """Coefficient variances at the optimum, reported in original space.
+
+    Reference semantics (DistributedOptimizationProblem.scala:86-103):
+    - SIMPLE: element-wise inverse of the Hessian diagonal;
+    - FULL:   diagonal of the inverse Hessian via Cholesky
+              (util/Linalg.scala choleskyInverse).
+    The L2 term contributes l2 to every non-intercept diagonal entry.
+    Variances are computed in the optimization (transformed) space and mapped
+    back with Var(w_j) = Var(w'_j) * factor_j^2 (the inverse of
+    NormalizationContext.varToTransformedSpace).
+    """
+    if variance_computation == VarianceComputationType.NONE:
+        return None
+    d = coef_transformed.shape[-1]
+    l2_diag = jnp.full((d,), l2_weight, dtype=coef_transformed.dtype)
+    if intercept_index is not None:
+        l2_diag = l2_diag.at[intercept_index].set(0.0)
+
+    if variance_computation == VarianceComputationType.SIMPLE:
+        diag = glm_ops.hessian_diagonal(batch, loss, coef_transformed, norm) + l2_diag
+        var_t = 1.0 / jnp.where(diag == 0.0, jnp.inf, diag)
+    else:
+        h = glm_ops.hessian_matrix(batch, loss, coef_transformed, norm)
+        h = h + jnp.diag(l2_diag)
+        # diagonal of H^-1 via Cholesky: solve for the identity columns
+        chol = jnp.linalg.cholesky(h)
+        inv = jax.scipy.linalg.cho_solve((chol, True), jnp.eye(d, dtype=h.dtype))
+        var_t = jnp.diagonal(inv)
+
+    if norm.factors is not None:
+        var_t = var_t * norm.factors * norm.factors
+    return var_t
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMOptimizationProblem:
+    """One GLM fit: objective assembly, transformed-space solve, round trip.
+
+    Serves as both the reference's DistributedOptimizationProblem (fixed
+    effect: ``batch`` sharded over the mesh) and, under vmap, its
+    SingleNodeOptimizationProblem (per-entity: ``batch`` is one entity's padded
+    block).
+    """
+
+    task: TaskType
+    config: GLMOptimizationConfiguration
+    normalization: NormalizationContext = dataclasses.field(
+        default_factory=no_normalization)
+    intercept_index: int | None = None
+
+    @property
+    def loss(self) -> losses_mod.PointwiseLoss:
+        return losses_mod.get_loss(self.task)
+
+    def initial_coefficients(self, dim: int, dtype=jnp.float32) -> Coefficients:
+        """Zero model init (GeneralizedLinearOptimizationProblem
+        initializeZeroModel)."""
+        return Coefficients.zeros(dim, dtype=dtype)
+
+    def run(
+        self,
+        batch: GLMBatch,
+        initial: Coefficients | None = None,
+    ) -> GLMSolution:
+        """Fit on ``batch``; returns the model in original feature space.
+
+        Matches Optimizer.optimize + DistributedOptimizationProblem.run: the
+        initial (original-space) coefficients are mapped to transformed space,
+        the solver runs there against the raw data via effective coefficients,
+        and means/variances are mapped back.
+        """
+        d = batch.num_features
+        dtype = batch.labels.dtype
+        w0_orig = (initial.means if initial is not None
+                   else jnp.zeros(d, dtype=dtype))
+        w0 = self.normalization.coef_to_transformed_space(w0_orig)
+
+        fun = glm_ops.make_value_and_grad(batch, self.loss, self.normalization)
+        hvp = None
+        if self.config.optimizer.optimizer_type == optim.OptimizerType.TRON:
+            hvp = glm_ops.make_hvp(batch, self.loss, self.normalization)
+
+        result = optim.solve(
+            fun,
+            w0,
+            self.config.optimizer,
+            l1_weight=self.config.l1_weight,
+            l2_weight=self.config.l2_weight,
+            intercept_index=self.intercept_index,
+            hvp=hvp,
+        )
+
+        variances = compute_variances(
+            batch,
+            self.loss,
+            result.coefficients,
+            self.normalization,
+            self.config.l2_weight,
+            self.intercept_index,
+            self.config.variance_computation,
+        )
+        means = self.normalization.coef_to_original_space(result.coefficients)
+        model = GeneralizedLinearModel(
+            Coefficients(means=means, variances=variances), self.task)
+        return GLMSolution(model=model, result=result)

@@ -127,6 +127,10 @@ class GLMBatch:
         coordinate descent (Coordinate.scala:52-53 addScoresToOffsets)."""
         return dataclasses.replace(self, offsets=offsets)
 
+    def with_weights(self, weights: Array) -> "GLMBatch":
+        """Functional weight update (down-sampling masks)."""
+        return dataclasses.replace(self, weights=weights)
+
     def weighted_count(self) -> Array:
         return jnp.sum(self.weights)
 

@@ -1,0 +1,109 @@
+"""GameDataset: the canonical columnar table every coordinate trains against.
+
+TPU-native counterpart of the reference's ``RDD[(UniqueSampleId, GameDatum)]``
+(photon-api data/GameDatum.scala:37, GameConverters.scala:28): response /
+offset / weight columns, one feature matrix per feature shard, and integer-
+coded id tags (the ``idTagToValueMap``: random-effect grouping columns and
+evaluation grouping columns).
+
+Because every array shares one canonical row order fixed at ingest, all of
+the reference's join/groupByKey plumbing (keying by uid, routing residuals by
+REId) reduces to index arithmetic: a coordinate's scores are a [n] device
+array aligned with this table (the CoordinateDataScores equivalent,
+data/scoring/CoordinateDataScores.scala:30).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.dataset import Features, GLMBatch
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class IdTag:
+    """One grouping column: dense int codes + the key vocabulary."""
+
+    codes: Array  # [n] int32
+    vocab: dict  # raw key -> code
+    inverse: tuple  # code -> raw key
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.inverse)
+
+    @staticmethod
+    def from_raw(raw_ids) -> "IdTag":
+        raw = np.asarray(raw_ids)
+        uniq, codes = np.unique(raw, return_inverse=True)
+        keys = tuple(k.item() if hasattr(k, "item") else k for k in uniq)
+        return IdTag(
+            codes=jnp.asarray(codes.astype(np.int32)),
+            vocab={k: i for i, k in enumerate(keys)},
+            inverse=keys,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class GameDataset:
+    """Columnar GAME table in canonical row order."""
+
+    labels: Array  # [n]
+    offsets: Array  # [n]
+    weights: Array  # [n]
+    feature_shards: dict[str, Features]
+    id_tags: dict[str, IdTag]
+    uids: np.ndarray | None = None  # host-side original row ids, optional
+
+    @property
+    def num_samples(self) -> int:
+        return int(self.labels.shape[0])
+
+    def shard_batch(self, shard_id: str) -> GLMBatch:
+        """A GLMBatch view for one feature shard (FixedEffectDataset
+        equivalent, data/FixedEffectDataset.scala:32)."""
+        return GLMBatch(
+            features=self.feature_shards[shard_id],
+            labels=self.labels,
+            offsets=self.offsets,
+            weights=self.weights,
+        )
+
+    def tag_codes(self, tag: str) -> tuple[Array, int]:
+        t = self.id_tags[tag]
+        return t.codes, t.num_groups
+
+
+def make_game_dataset(
+    labels,
+    feature_shards: dict[str, Features],
+    *,
+    offsets=None,
+    weights=None,
+    id_tags: dict[str, np.ndarray] | None = None,
+    uids=None,
+    dtype=jnp.float32,
+) -> GameDataset:
+    labels = jnp.asarray(np.asarray(labels), dtype=dtype)
+    n = labels.shape[0]
+    for name, feats in feature_shards.items():
+        rows = (feats.x.shape[0] if hasattr(feats, "x") else feats.indices.shape[0])
+        if rows != n:
+            raise ValueError(
+                f"feature shard {name!r} has {rows} rows, expected {n}")
+    return GameDataset(
+        labels=labels,
+        offsets=(jnp.zeros(n, dtype) if offsets is None
+                 else jnp.asarray(np.asarray(offsets), dtype)),
+        weights=(jnp.ones(n, dtype) if weights is None
+                 else jnp.asarray(np.asarray(weights), dtype)),
+        feature_shards=dict(feature_shards),
+        id_tags={k: IdTag.from_raw(v) for k, v in (id_tags or {}).items()},
+        uids=None if uids is None else np.asarray(uids),
+    )

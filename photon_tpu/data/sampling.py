@@ -1,0 +1,63 @@
+"""Down-sampling as deterministic weight masking.
+
+TPU-native counterpart of photon-lib sampling/DownSampler.scala:68,
+BinaryClassificationDownSampler.scala:32 and DefaultDownSampler.scala:41.
+
+The reference filters RDD rows; filtering changes shapes, so here dropped
+rows get weight 0 instead — aggregations treat them exactly like filtered
+rows and every shape stays static (no recompilation per sample draw).
+
+Semantics preserved:
+- binary tasks: keep all positives, keep negatives with probability ``rate``
+  and rescale surviving negative weights by 1/rate (unbiased gradient);
+- other tasks: keep rows uniformly with probability ``rate`` with NO weight
+  rescale (DefaultDownSampler uses a plain RDD sample);
+- seeded and deterministic (the reference seeds its samplers so lineage
+  recomputation reproduces draws; here determinism comes from the explicit
+  PRNG key).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.dataset import GLMBatch
+
+Array = jax.Array
+
+_POS = 0.5
+
+
+def downsample_binary_negatives(
+    batch: GLMBatch, rate: float, key: Array
+) -> GLMBatch:
+    """Negative down-sampling with weight rescale
+    (BinaryClassificationDownSampler.scala:50-54)."""
+    if not 0.0 < rate < 1.0:
+        raise ValueError(f"down-sampling rate must be in (0, 1): {rate}")
+    keep = jax.random.uniform(key, batch.labels.shape) < rate
+    is_pos = batch.labels > _POS
+    new_w = jnp.where(
+        is_pos,
+        batch.weights,
+        jnp.where(keep, batch.weights / rate, 0.0),
+    )
+    return batch.with_weights(new_w)
+
+
+def downsample_uniform(batch: GLMBatch, rate: float, key: Array) -> GLMBatch:
+    """Uniform down-sampling, no weight rescale (DefaultDownSampler.scala:
+    plain ``RDD.sample``)."""
+    if not 0.0 < rate < 1.0:
+        raise ValueError(f"down-sampling rate must be in (0, 1): {rate}")
+    keep = jax.random.uniform(key, batch.labels.shape) < rate
+    return batch.with_weights(jnp.where(keep, batch.weights, 0.0))
+
+
+def downsample(
+    batch: GLMBatch, rate: float, key: Array, *, binary: bool
+) -> GLMBatch:
+    if binary:
+        return downsample_binary_negatives(batch, rate, key)
+    return downsample_uniform(batch, rate, key)
