@@ -79,6 +79,42 @@ class GLMSolution:
     result: optim.OptResult
 
 
+def variances_in_transformed_space(
+    batch: GLMBatch,
+    loss: losses_mod.PointwiseLoss,
+    coef_transformed: Array,
+    norm: NormalizationContext,
+    l2_diag: Array,
+    variance_computation: VarianceComputationType,
+) -> Array:
+    """Transformed-space coefficient variances at the optimum.
+
+    Shared core of the fixed-effect and (vmapped) random-effect variance
+    paths. Reference semantics (DistributedOptimizationProblem.scala:86-103):
+    - SIMPLE: element-wise inverse of the Hessian diagonal;
+    - FULL:   diagonal of the inverse Hessian via Cholesky
+              (util/Linalg.scala choleskyInverse).
+    ``l2_diag`` is the per-coefficient L2 diagonal (0 at the intercept and at
+    padded subspace slots). Slots with zero curvature — no data support and
+    no L2 — get infinite variance instead of poisoning the Cholesky.
+    """
+    if variance_computation == VarianceComputationType.SIMPLE:
+        diag = glm_ops.hessian_diagonal(batch, loss, coef_transformed, norm)
+        diag = diag + l2_diag
+        return 1.0 / jnp.where(diag == 0.0, jnp.inf, diag)
+
+    h = glm_ops.hessian_matrix(batch, loss, coef_transformed, norm)
+    h = h + jnp.diag(l2_diag)
+    # Zero-curvature slots would make H singular; pin their diagonal to 1 and
+    # report infinite variance for them.
+    dead = jnp.diagonal(h) == 0.0
+    h = h + jnp.diag(jnp.where(dead, 1.0, 0.0))
+    d = coef_transformed.shape[-1]
+    chol = jnp.linalg.cholesky(h)
+    inv = jax.scipy.linalg.cho_solve((chol, True), jnp.eye(d, dtype=h.dtype))
+    return jnp.where(dead, jnp.inf, jnp.diagonal(inv))
+
+
 def compute_variances(
     batch: GLMBatch,
     loss: losses_mod.PointwiseLoss,
@@ -90,10 +126,6 @@ def compute_variances(
 ) -> Array | None:
     """Coefficient variances at the optimum, reported in original space.
 
-    Reference semantics (DistributedOptimizationProblem.scala:86-103):
-    - SIMPLE: element-wise inverse of the Hessian diagonal;
-    - FULL:   diagonal of the inverse Hessian via Cholesky
-              (util/Linalg.scala choleskyInverse).
     The L2 term contributes l2 to every non-intercept diagonal entry.
     Variances are computed in the optimization (transformed) space and mapped
     back with Var(w_j) = Var(w'_j) * factor_j^2 (the inverse of
@@ -106,17 +138,9 @@ def compute_variances(
     if intercept_index is not None:
         l2_diag = l2_diag.at[intercept_index].set(0.0)
 
-    if variance_computation == VarianceComputationType.SIMPLE:
-        diag = glm_ops.hessian_diagonal(batch, loss, coef_transformed, norm) + l2_diag
-        var_t = 1.0 / jnp.where(diag == 0.0, jnp.inf, diag)
-    else:
-        h = glm_ops.hessian_matrix(batch, loss, coef_transformed, norm)
-        h = h + jnp.diag(l2_diag)
-        # diagonal of H^-1 via Cholesky: solve for the identity columns
-        chol = jnp.linalg.cholesky(h)
-        inv = jax.scipy.linalg.cho_solve((chol, True), jnp.eye(d, dtype=h.dtype))
-        var_t = jnp.diagonal(inv)
-
+    var_t = variances_in_transformed_space(
+        batch, loss, coef_transformed, norm, l2_diag, variance_computation
+    )
     if norm.factors is not None:
         var_t = var_t * norm.factors * norm.factors
     return var_t

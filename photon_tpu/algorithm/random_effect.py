@@ -1,0 +1,321 @@
+"""RandomEffectCoordinate: batched vmapped per-entity GLM solves.
+
+TPU-native counterpart of photon-api algorithm/RandomEffectCoordinate.scala:38
+and optimization/game/RandomEffectOptimizationProblem.scala:45. The
+reference's design — join activeData with per-entity
+SingleNodeOptimizationProblems and run a *local* Breeze optimizer per entity
+inside ``mapValues`` (:243-292) — becomes: for each size bucket of entities,
+ONE jitted ``vmap`` of the full L-BFGS/OWL-QN/TRON while_loop over the entity
+axis. JAX's while_loop batching rule gives masked per-entity convergence for
+free (converged entities stop changing), the analog of heterogeneous
+convergence across executor-local solves (SURVEY §7.3).
+
+Per-entity projected normalization contexts
+(RandomEffectOptimizationProblem.scala:137-198) are gathers of the global
+factor/shift vectors through the entity's projector; the per-entity intercept
+slot is a traced index, so coefficient space round-trips use one-hot masks
+instead of static-index updates.
+
+Scoring covers active AND passive rows uniformly via the dataset's remapped
+scoring table (scoreActiveData :314-332 / scorePassiveData :346-366 collapse
+into one gather-multiply-reduce).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu import optim
+from photon_tpu.algorithm.problems import (
+    GLMOptimizationConfiguration,
+    VarianceComputationType,
+    variances_in_transformed_space,
+)
+from photon_tpu.data.dataset import GLMBatch, SparseFeatures
+from photon_tpu.data.random_effect import EntityBlocks, RandomEffectDataset
+from photon_tpu.models.game import RandomEffectModel
+from photon_tpu.ops import glm as glm_ops
+from photon_tpu.ops import losses as losses_mod
+from photon_tpu.ops.normalization import NormalizationContext
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectTrainingStats:
+    """Aggregate per-entity solver diagnostics.
+
+    Reference: RandomEffectOptimizationTracker (optimization/
+    RandomEffectOptimizationTracker.scala:89) — counts of convergence reasons
+    plus iteration stats over entities.
+    """
+
+    convergence_reason_counts: dict[str, int]
+    iterations_mean: float
+    iterations_max: int
+    num_entities: int
+
+    @staticmethod
+    def from_arrays(reasons: np.ndarray, iterations: np.ndarray):
+        counts: dict[str, int] = {}
+        for code, cnt in zip(*np.unique(reasons, return_counts=True)):
+            counts[optim.ConvergenceReason(int(code)).name] = int(cnt)
+        return RandomEffectTrainingStats(
+            convergence_reason_counts=counts,
+            iterations_mean=float(iterations.mean()) if iterations.size else 0.0,
+            iterations_max=int(iterations.max()) if iterations.size else 0,
+            num_entities=int(iterations.size),
+        )
+
+
+def _onehot(slot: Array, dim: int, dtype) -> Array:
+    """One-hot of a traced (possibly -1) slot index; all-zero when slot < 0."""
+    iota = jnp.arange(dim)
+    return jnp.where(iota == slot, 1.0, 0.0).astype(dtype)
+
+
+def _coef_to_transformed(w, factors, shifts, int_onehot):
+    if shifts is not None:
+        w = w + jnp.dot(w, shifts) * int_onehot
+    if factors is not None:
+        w = w / factors
+    return w
+
+
+def _coef_to_original(w_t, factors, shifts, int_onehot):
+    w = w_t if factors is None else w_t * factors
+    if shifts is not None:
+        w = w - jnp.dot(w, shifts) * int_onehot
+    return w
+
+
+def _solve_one_entity(
+    x_indices: Array,  # [R, k]
+    x_values: Array,  # [R, k]
+    labels: Array,  # [R]
+    offsets: Array,  # [R]
+    weights: Array,  # [R]
+    penalty_mask: Array,  # [S]
+    valid_mask: Array,  # [S]
+    factors: Array,  # [S] (ones where no normalization)
+    shifts: Array,  # [S] (zeros where none)
+    intercept_slot: Array,  # scalar int32, -1 if absent
+    w0_orig: Array,  # [S] original-space warm start
+    *,
+    sub_dim: int,
+    task: TaskType,
+    config: GLMOptimizationConfiguration,
+):
+    """One entity's full solve; vmapped over the bucket's entity axis.
+
+    Mirrors SingleNodeOptimizationProblem.run (:90-98): transformed-space
+    solve with the effective-coefficient rewrite, reported in original space.
+    """
+    loss = losses_mod.get_loss(task)
+    feats = SparseFeatures(x_indices, x_values, sub_dim)
+    batch = GLMBatch(feats, labels, offsets, weights)
+    # Per-entity projected normalization; factors/shifts are None (static)
+    # when the coordinate has no normalization, so the objective specializes
+    # to the raw fast path at trace time. intercept_index is only consulted
+    # by the static-index round-trip helpers, which we bypass.
+    norm = NormalizationContext(
+        factors=factors,
+        shifts=shifts,
+        intercept_index=None if shifts is None else 0,
+    )
+    int_onehot = (
+        None if shifts is None
+        else _onehot(intercept_slot, sub_dim, w0_orig.dtype)
+    )
+
+    w0 = _coef_to_transformed(w0_orig, factors, shifts, int_onehot)
+    fun = glm_ops.make_value_and_grad(batch, loss, norm)
+    l1 = config.l1_weight
+    l2 = config.l2_weight
+    obj = fun if l2 == 0.0 else optim.with_l2_masked(fun, l2, penalty_mask)
+
+    if l1 != 0.0:
+        result = optim.owlqn_solve(obj, w0, l1, config.optimizer)
+    elif config.optimizer.optimizer_type == optim.OptimizerType.TRON:
+        hvp = glm_ops.make_hvp(batch, loss, norm)
+        obj_hvp = (
+            hvp if l2 == 0.0
+            else optim.with_l2_hvp_masked(hvp, l2, penalty_mask)
+        )
+        result = optim.tron_solve(obj, obj_hvp, w0, config.optimizer)
+    else:
+        result = optim.lbfgs_solve(obj, w0, config.optimizer)
+
+    w_t = result.coefficients * valid_mask
+
+    if config.variance_computation != VarianceComputationType.NONE:
+        var_t = variances_in_transformed_space(
+            batch, loss, w_t, norm, l2 * penalty_mask,
+            config.variance_computation,
+        )
+        f_sq = 1.0 if factors is None else factors * factors
+        # Padded slots (and zero-support slots) carry var inf; report 0 for
+        # padding, inf for genuinely unsupported-but-valid slots.
+        variances = jnp.where(valid_mask > 0, var_t * f_sq, 0.0)
+    else:
+        variances = jnp.zeros_like(w_t)
+
+    w_orig = _coef_to_original(w_t, factors, shifts, int_onehot) * valid_mask
+    return w_orig, variances, result.iterations, result.convergence_reason
+
+
+@functools.partial(jax.jit, static_argnames=("sub_dim", "task", "config"))
+def _solve_block(
+    block: EntityBlocks,
+    offsets: Array,  # [B, R] effective offsets (base + residuals)
+    factors_sub: Array,  # [B, S]
+    shifts_sub: Array,  # [B, S]
+    w0: Array,  # [B, S] original-space warm starts
+    *,
+    sub_dim: int,
+    task: TaskType,
+    config: GLMOptimizationConfiguration,
+):
+    solver = functools.partial(
+        _solve_one_entity, sub_dim=sub_dim, task=task, config=config
+    )
+    return jax.vmap(solver)(
+        block.x_indices,
+        block.x_values,
+        block.labels,
+        offsets,
+        block.weights,
+        block.penalty_mask,
+        block.valid_mask,
+        factors_sub,
+        shifts_sub,
+        block.intercept_slots,
+        w0,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectCoordinate:
+    """Per-entity coordinate over one random-effect type.
+
+    Reference: algorithm/RandomEffectCoordinate.scala:38 (trainModel
+    :234-300, scoring :314-366).
+    """
+
+    dataset: RandomEffectDataset
+    task: TaskType
+    config: GLMOptimizationConfiguration
+    normalization: NormalizationContext = dataclasses.field(
+        default_factory=NormalizationContext
+    )
+
+    def _projected_norms(self, block: EntityBlocks, dtype):
+        """Gather the global factor/shift vectors through each entity's
+        projector (RandomEffectOptimizationProblem projected contexts).
+        None (not materialized ones/zeros) when no normalization is set, so
+        the jitted solver specializes to the raw fast path."""
+        proj = block.proj  # [B, S]; -1 pad
+        safe = jnp.maximum(proj, 0)
+        f = s = None
+        if self.normalization.factors is not None:
+            f = jnp.take(self.normalization.factors.astype(dtype), safe)
+            f = jnp.where(proj >= 0, f, 1.0)
+        if self.normalization.shifts is not None:
+            s = jnp.take(self.normalization.shifts.astype(dtype), safe)
+            s = jnp.where(proj >= 0, s, 0.0)
+        return f, s
+
+    def train(
+        self,
+        residuals: Array | None = None,
+        initial_model: RandomEffectModel | None = None,
+        *,
+        seed: int = 0,
+    ) -> tuple[RandomEffectModel, RandomEffectTrainingStats]:
+        ds = self.dataset
+        dtype = ds.score_values.dtype
+        w_all = jnp.zeros((ds.num_entities, ds.max_sub_dim), dtype)
+        v_all = (
+            jnp.zeros((ds.num_entities, ds.max_sub_dim), dtype)
+            if self.config.variance_computation != VarianceComputationType.NONE
+            else None
+        )
+        reasons: list[np.ndarray] = []
+        iters: list[np.ndarray] = []
+
+        if self.normalization.shifts is not None:
+            # Shift normalization folds the shift mass into the intercept on
+            # the coefficient round trip; every trained entity must have one
+            # (the per-entity analog of NormalizationContext.__post_init__).
+            for block in ds.blocks:
+                if bool((np.asarray(block.intercept_slots) < 0).any()):
+                    raise ValueError(
+                        "normalization with shifts requires every entity's "
+                        "subspace to contain the intercept; build the "
+                        "dataset with intercept_index set"
+                    )
+
+        for block in ds.blocks:
+            s = block.sub_dim
+            offsets = block.offsets
+            if residuals is not None:
+                # Padding rows alias canonical row 0; mask their gather.
+                offsets = offsets + jnp.where(
+                    block.weights > 0, jnp.take(residuals, block.row_ids), 0.0
+                )
+            f, sh = self._projected_norms(block, dtype)
+            if initial_model is not None:
+                # Warm start assumes the initial model shares this dataset's
+                # projector layout (true across CD iterations and lambda
+                # configs; external models are remapped by the estimator).
+                w0 = jnp.take(
+                    initial_model.coefficients.astype(dtype),
+                    block.entity_codes,
+                    axis=0,
+                )[:, :s]
+            else:
+                w0 = jnp.zeros((block.num_entities, s), dtype)
+            w, v, it, reason = _solve_block(
+                block,
+                offsets,
+                f,
+                sh,
+                w0,
+                sub_dim=s,
+                task=self.task,
+                config=self.config,
+            )
+            pad = ds.max_sub_dim - s
+            if pad:
+                w = jnp.pad(w, ((0, 0), (0, pad)))
+                v = jnp.pad(v, ((0, 0), (0, pad)))
+            w_all = w_all.at[block.entity_codes].set(w)
+            if v_all is not None:
+                v_all = v_all.at[block.entity_codes].set(v)
+            reasons.append(np.asarray(reason))
+            iters.append(np.asarray(it))
+
+        model = RandomEffectModel(
+            coefficients=w_all,
+            random_effect_type=ds.config.random_effect_type,
+            feature_shard_id=ds.config.feature_shard_id,
+            task=self.task,
+            proj_all=ds.proj_all,
+            variances=v_all,
+            entity_keys=ds.entity_keys,
+        )
+        stats = RandomEffectTrainingStats.from_arrays(
+            np.concatenate(reasons) if reasons else np.empty(0, np.int32),
+            np.concatenate(iters) if iters else np.empty(0, np.int32),
+        )
+        return model, stats
+
+    def score(self, model: RandomEffectModel) -> Array:
+        """Model contribution per canonical row (active + passive)."""
+        return model.score_dataset(self.dataset)

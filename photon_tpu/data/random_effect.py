@@ -1,0 +1,393 @@
+"""RandomEffectDataset: per-entity data as size-bucketed padded device blocks.
+
+TPU-native counterpart of the heart of GLMix scaling (photon-api
+data/RandomEffectDataset.scala:54, apply :264-354). The reference's build
+pipeline — key by REId, per-entity ``LinearSubspaceProjector`` from the union
+of active feature indices (:390-426), deterministic reservoir-sampling cap
+(groupDataByKeyAndSample :468-527 with byteswap64 hash keys :510), feature
+projection to the subspace (:538-550), optional Pearson-correlation feature
+selection (:562-576), active-data lower-bound filter (:586-606) and passive
+data as the leftovers (:631-640) — happens ONCE, host-side at ingest, and
+produces static device arrays:
+
+- **EntityBlocks** (training): entities grouped into size buckets; each bucket
+  is a ``[B, R, k]`` ELL slab plus per-entity projector index arrays, so one
+  vmapped solver call fits all B entities simultaneously. This replaces the
+  reference's per-partition ``mapValues`` local solves
+  (RandomEffectCoordinate.scala:243-292) and its partitioner bin-packing
+  (RandomEffectDatasetPartitioner.scala:44): padding buckets instead of
+  packing bins.
+- **Scoring table** (active + passive rows): the full canonical table with
+  feature indices remapped into each row's owning entity's subspace, so
+  coordinate scoring is one gather-multiply-reduce against the
+  ``[num_entities, max_sub_dim]`` coefficient matrix — no join by REId.
+  Features outside an entity's subspace have their values zeroed (the
+  projector drop semantics of LinearSubspaceProjector.projectForward).
+
+Residual routing (addScoresToOffsets :83-110) reduces to gathering the
+canonical offsets vector through each block's ``row_ids``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.dataset import DenseFeatures, Features, SparseFeatures
+from photon_tpu.data.game_data import GameDataset
+
+Array = jax.Array
+
+# Row-count caps for entity size buckets: entities are padded up to the next
+# cap, so worst-case padding waste is 2x within a bucket (SURVEY §7.3).
+DEFAULT_BUCKET_CAPS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataConfiguration:
+    """Per-coordinate random-effect data config.
+
+    Reference: RandomEffectDataConfiguration in
+    data/CoordinateDataConfiguration.scala:77 — REType, feature shard, active
+    data bounds, features-to-samples ratio (Pearson filter).
+    """
+
+    random_effect_type: str
+    feature_shard_id: str
+    active_data_upper_bound: int | None = None
+    active_data_lower_bound: int | None = None
+    features_to_samples_ratio: float | None = None
+    bucket_caps: tuple[int, ...] = DEFAULT_BUCKET_CAPS
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class EntityBlocks:
+    """One size bucket of entities, padded to common shapes.
+
+    Training slab for a vmapped per-entity solver: leading axis B is the
+    entity axis. Padding rows carry weight 0; padded subspace slots have
+    ``proj == -1`` and never receive data gradient.
+    """
+
+    entity_codes: Array  # [B] int32 — global entity code per slot
+    x_indices: Array  # [B, R, k] int32, subspace-remapped
+    x_values: Array  # [B, R, k]
+    labels: Array  # [B, R]
+    offsets: Array  # [B, R] base offsets (residuals added per train call)
+    weights: Array  # [B, R]; 0 for padding rows
+    row_ids: Array  # [B, R] int32 canonical row ids; 0 for padding (weight 0)
+    proj: Array  # [B, S] int32 original feature id per subspace slot; -1 pad
+    penalty_mask: Array  # [B, S] 1.0 for penalized slots (valid, non-intercept)
+    valid_mask: Array  # [B, S] 1.0 for valid subspace slots
+    intercept_slots: Array  # [B] int32 subspace slot of intercept; -1 if none
+
+    @property
+    def num_entities(self) -> int:
+        return self.entity_codes.shape[0]
+
+    @property
+    def sub_dim(self) -> int:
+        return self.proj.shape[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataset:
+    """All device-resident state for one random-effect coordinate."""
+
+    config: RandomEffectDataConfiguration
+    num_entities: int
+    entity_keys: tuple  # code -> raw entity key
+    blocks: tuple[EntityBlocks, ...]  # active data, size-bucketed
+    # Full-table scoring arrays (every canonical row, active AND passive):
+    score_codes: Array  # [n] int32 owning-entity code per row
+    score_indices: Array  # [n, k] int32 subspace-remapped; 0 where dropped
+    score_values: Array  # [n, k]; 0 where the feature is outside the subspace
+    max_sub_dim: int
+    sub_dims: np.ndarray  # [E] host-side subspace dims
+    proj_all: np.ndarray  # [E, max_sub_dim] original feature ids; -1 pad
+    num_features: int  # original feature-space dim of the shard
+
+    @property
+    def num_active_entities(self) -> int:
+        return sum(b.num_entities for b in self.blocks)
+
+
+def _stable_type_seed(re_type: str) -> np.uint64:
+    """Deterministic 64-bit seed from the REType name (the reference XORs
+    ``REType.hashCode`` into the sample key, RandomEffectDataset.scala:510)."""
+    import zlib
+
+    return np.uint64(zlib.crc32(re_type.encode()) | (0x9E3779B9 << 32))
+
+
+def _byteswap64_mix(uids: np.ndarray, seed: np.uint64) -> np.ndarray:
+    """splitmix64-style deterministic hash of sample ids — the moral
+    equivalent of the reference's ``byteswap64(hash ^ uid)`` reservoir keys:
+    a fixed pseudo-random total order over samples, reproducible across
+    re-ingests (SURVEY §5.2 determinism requirement)."""
+    z = uids.astype(np.uint64) ^ seed
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def _rows_to_coo(features: Features) -> tuple[np.ndarray, np.ndarray, int]:
+    """Host-side (indices[n, k], values[n, k]) view of a feature shard."""
+    if isinstance(features, SparseFeatures):
+        return (
+            np.asarray(features.indices),
+            np.asarray(features.values),
+            features.d,
+        )
+    assert isinstance(features, DenseFeatures)
+    x = np.asarray(features.x)
+    n, d = x.shape
+    idx = np.broadcast_to(np.arange(d, dtype=np.int32), (n, d))
+    return idx.copy(), x.copy(), d
+
+
+def _remap_ell_rows(
+    idx_rows: np.ndarray,  # [r, k_in] original feature ids
+    val_rows: np.ndarray,  # [r, k_in]
+    lut: np.ndarray,  # [num_features] original -> sub slot, -1 dropped
+    k_out: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized subspace remap: gather slots, compact valid entries left."""
+    sub = lut[idx_rows]  # [r, k_in]
+    valid = (val_rows != 0.0) & (sub >= 0)
+    order = np.argsort(~valid, axis=1, kind="stable")  # valid entries first
+    sub_c = np.take_along_axis(np.where(valid, sub, 0), order, axis=1)
+    val_c = np.take_along_axis(np.where(valid, val_rows, 0.0), order, axis=1)
+    return sub_c[:, :k_out].astype(np.int32), val_c[:, :k_out]
+
+
+def _pearson_select(
+    values: np.ndarray,  # [r, k] ELL values for one entity's active rows
+    indices: np.ndarray,  # [r, k]
+    labels: np.ndarray,  # [r]
+    active_features: np.ndarray,  # sorted original ids
+    keep: int,
+    intercept_index: int | None,
+    num_features: int,
+) -> np.ndarray:
+    """Rank an entity's active features by |Pearson corr with the label| and
+    keep the top ``keep`` (intercept always kept).
+
+    Reference: LocalDataset.filterFeaturesByPearsonCorrelationScore
+    (data/LocalDataset.scala:103, stableComputePearsonCorrelationScore :132):
+    features with near-constant columns get score ~0 except the intercept,
+    which is always retained.
+    """
+    if keep >= active_features.size:
+        return active_features
+    r = labels.shape[0]
+    pos = np.full(num_features, -1, dtype=np.int64)
+    pos[active_features] = np.arange(active_features.size)
+    sub = pos[indices]
+    valid = (values != 0.0) & (sub >= 0)
+    rows = np.broadcast_to(np.arange(r)[:, None], indices.shape)
+    cols = np.zeros((r, active_features.size), dtype=np.float64)
+    cols[rows[valid], sub[valid]] = values[valid]
+    y = labels.astype(np.float64)
+    yc = y - y.mean()
+    xc = cols - cols.mean(axis=0, keepdims=True)
+    num = xc.T @ yc
+    den = np.sqrt((xc * xc).sum(axis=0) * (yc * yc).sum()) + 1e-12
+    score = np.abs(num / den)
+    if intercept_index is not None and pos[intercept_index] >= 0:
+        score[pos[intercept_index]] = np.inf  # always keep the intercept
+    order = np.argsort(-score, kind="stable")[:keep]
+    return np.sort(active_features[order])
+
+
+def build_random_effect_dataset(
+    game_data: GameDataset,
+    config: RandomEffectDataConfiguration,
+    *,
+    intercept_index: int | None = None,
+    extra_features: dict[int, np.ndarray] | None = None,
+    dtype=None,
+) -> RandomEffectDataset:
+    """One-shot host-side ingest of a random-effect coordinate's data.
+
+    ``extra_features`` maps entity code -> original feature ids that must be
+    in the entity's subspace even if inactive in the data — the prior-model
+    support used for warm-start/incremental training
+    (RandomEffectDataset.scala:390-426 unions the existing model's features).
+    """
+    if dtype is None:
+        dtype = game_data.labels.dtype
+    tag = game_data.id_tags[config.random_effect_type]
+    codes = np.asarray(tag.codes)
+    num_entities = tag.num_groups
+    n = codes.shape[0]
+
+    feats = game_data.feature_shards[config.feature_shard_id]
+    ell_idx, ell_val, num_features = _rows_to_coo(feats)
+    labels_np = np.asarray(game_data.labels)
+    offsets_np = np.asarray(game_data.offsets)
+    weights_np = np.asarray(game_data.weights)
+    uids = (
+        game_data.uids.astype(np.int64)
+        if game_data.uids is not None
+        else np.arange(n, dtype=np.int64)
+    )
+
+    # --- 1. deterministic reservoir cap: per entity keep the
+    # active_data_upper_bound rows with smallest hash keys -----------------
+    seed = _stable_type_seed(config.random_effect_type)
+    order_keys = _byteswap64_mix(uids, seed)
+    # Sort rows by (entity, hash key): each entity's rows become contiguous in
+    # a deterministic pseudo-random order.
+    perm = np.lexsort((order_keys, codes))
+    sorted_codes = codes[perm]
+    starts = np.searchsorted(sorted_codes, np.arange(num_entities))
+    ends = np.searchsorted(sorted_codes, np.arange(num_entities), side="right")
+
+    upper = config.active_data_upper_bound
+    lower = config.active_data_lower_bound
+
+    entity_rows: list[np.ndarray] = []
+    active = np.zeros(num_entities, dtype=bool)
+    for e in range(num_entities):
+        rows = perm[starts[e] : ends[e]]
+        if upper is not None and rows.size > upper:
+            rows = rows[:upper]
+        entity_rows.append(rows)
+        # Lower-bound filter: too-small entities train no model (their rows
+        # still score via the zero row of the coefficient matrix).
+        active[e] = rows.size >= (lower or 1)
+
+    # --- 2. per-entity subspace projectors --------------------------------
+    projs: list[np.ndarray] = [np.empty(0, dtype=np.int64)] * num_entities
+    sub_dims = np.zeros(num_entities, dtype=np.int64)
+    for e in range(num_entities):
+        if not active[e]:
+            continue
+        rows = entity_rows[e]
+        vals = ell_val[rows]
+        idxs = ell_idx[rows]
+        act = np.unique(idxs[vals != 0.0])
+        ratio = config.features_to_samples_ratio
+        if ratio is not None:
+            keep = max(int(ratio * rows.size), 1)
+            act = _pearson_select(
+                vals, idxs, labels_np[rows], act, keep, intercept_index,
+                num_features,
+            )
+        # Prior-model support is unioned AFTER the Pearson filter: features a
+        # warm-start model depends on must stay in the subspace even when
+        # inactive/filtered in the current data (RandomEffectDataset.scala:
+        # 390-426 unions the existing model's features unconditionally).
+        if extra_features and e in extra_features:
+            act = np.union1d(act, np.asarray(extra_features[e], dtype=act.dtype))
+        projs[e] = act
+        sub_dims[e] = act.size
+
+    max_sub_dim = int(sub_dims.max()) if num_entities else 1
+    max_sub_dim = max(max_sub_dim, 1)
+    proj_all = np.full((num_entities, max_sub_dim), -1, dtype=np.int64)
+    for e in range(num_entities):
+        proj_all[e, : sub_dims[e]] = projs[e]
+
+    # --- 3. size-bucketed training blocks ---------------------------------
+    caps = sorted(config.bucket_caps)
+    active_ids = np.nonzero(active)[0]
+    bucket_of: dict[int, list[int]] = {}
+    for e in active_ids:
+        r = entity_rows[e].size
+        cap = next((c for c in caps if r <= c), r)
+        bucket_of.setdefault(cap, []).append(int(e))
+
+    blocks = []
+    for cap in sorted(bucket_of):
+        members = bucket_of[cap]
+        b = len(members)
+        s = max(int(sub_dims[members].max()), 1)
+        # Per-bucket ELL capacity: the widest row among members.
+        k = 1
+        for e in members:
+            rows = entity_rows[e]
+            k = max(k, int((ell_val[rows] != 0.0).sum(axis=1).max(initial=0)))
+        bi = np.zeros((b, cap, k), dtype=np.int32)
+        bv = np.zeros((b, cap, k), dtype=ell_val.dtype)
+        bl = np.zeros((b, cap), dtype=labels_np.dtype)
+        bo = np.zeros((b, cap), dtype=offsets_np.dtype)
+        bw = np.zeros((b, cap), dtype=weights_np.dtype)
+        brow = np.zeros((b, cap), dtype=np.int32)
+        bproj = np.full((b, s), -1, dtype=np.int32)
+        bint = np.full(b, -1, dtype=np.int32)
+        remap = np.full(num_features, -1, dtype=np.int64)  # reused buffer
+        for t, e in enumerate(members):
+            rows = entity_rows[e]
+            act = projs[e]
+            remap[act] = np.arange(act.size)
+            bproj[t, : act.size] = act
+            if intercept_index is not None and remap[intercept_index] >= 0:
+                bint[t] = remap[intercept_index]
+            r = rows.size
+            bi[t, :r], bv[t, :r] = _remap_ell_rows(
+                ell_idx[rows], ell_val[rows], remap, k
+            )
+            bl[t, :r] = labels_np[rows]
+            bo[t, :r] = offsets_np[rows]
+            bw[t, :r] = weights_np[rows]
+            brow[t, :r] = rows
+            remap[act] = -1
+        slot = np.arange(s)[None, :]
+        valid = (slot < sub_dims[members][:, None]).astype(np.float32)
+        penalty = valid.copy()
+        has_int = bint >= 0
+        penalty[has_int, bint[has_int]] = 0.0
+        blocks.append(
+            EntityBlocks(
+                entity_codes=jnp.asarray(np.asarray(members, dtype=np.int32)),
+                x_indices=jnp.asarray(bi),
+                x_values=jnp.asarray(bv, dtype=dtype),
+                labels=jnp.asarray(bl, dtype=dtype),
+                offsets=jnp.asarray(bo, dtype=dtype),
+                weights=jnp.asarray(bw, dtype=dtype),
+                row_ids=jnp.asarray(brow),
+                proj=jnp.asarray(bproj),
+                penalty_mask=jnp.asarray(penalty, dtype=dtype),
+                valid_mask=jnp.asarray(valid, dtype=dtype),
+                intercept_slots=jnp.asarray(bint),
+            )
+        )
+
+    # --- 4. full-table scoring arrays (active + passive rows) -------------
+    k_all = max(int((ell_val != 0.0).sum(axis=1).max(initial=0)), 1)
+    si = np.zeros((n, k_all), dtype=np.int32)
+    sv = np.zeros((n, k_all), dtype=ell_val.dtype)
+    # Vectorized per entity: all of an entity's rows (active AND passive) are
+    # contiguous in the (entity, hash) sort; one reused lookup buffer keeps
+    # the whole pass O(total nnz).
+    lut = np.full(num_features, -1, dtype=np.int64)
+    for e in range(num_entities):
+        p = projs[e]
+        rows = perm[starts[e] : ends[e]]
+        if rows.size == 0:
+            continue
+        lut[p] = np.arange(p.size)
+        si[rows], sv[rows] = _remap_ell_rows(
+            ell_idx[rows], ell_val[rows], lut, k_all
+        )
+        lut[p] = -1
+
+    return RandomEffectDataset(
+        config=config,
+        num_entities=num_entities,
+        entity_keys=tag.inverse,
+        blocks=tuple(blocks),
+        score_codes=jnp.asarray(codes.astype(np.int32)),
+        score_indices=jnp.asarray(si),
+        score_values=jnp.asarray(sv, dtype=dtype),
+        max_sub_dim=max_sub_dim,
+        sub_dims=sub_dims,
+        proj_all=proj_all,
+        num_features=num_features,
+    )

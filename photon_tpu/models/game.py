@@ -1,0 +1,159 @@
+"""GAME model objects: fixed-effect, random-effect, and composite models.
+
+TPU-native counterpart of photon-api model/FixedEffectModel.scala:33 (a
+broadcast GLM + feature shard id), model/RandomEffectModel.scala:36 (an
+RDD[(REId, GLM)] + REType + shard; ``score`` :70 joins game data by REId) and
+photon-lib model/GameModel.scala:32 (ordered map coordinate id -> sub-model;
+scores sum across sub-models via ModelDataScores ``+``).
+
+The RDD-of-models becomes ONE padded coefficient matrix ``[num_entities,
+max_sub_dim]`` in entity-subspace coordinates: scoring is a two-level gather
+(entity row, subspace slot) fused with the multiply-reduce — the join by REId
+is index arithmetic. Entities with no trained model (below the active-data
+lower bound) occupy all-zero rows, matching the reference's behavior of
+contributing no score for unknown entities.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.random_effect import RandomEffectDataset
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """Global GLM + the feature shard it scores against.
+
+    Reference: model/FixedEffectModel.scala:33.
+    """
+
+    model: GeneralizedLinearModel
+    feature_shard_id: str
+
+    @property
+    def task(self) -> TaskType:
+        return self.model.task
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """All per-entity GLMs of one random-effect type, as a padded matrix.
+
+    ``coefficients[e, s]`` is entity e's coefficient for its subspace slot s;
+    ``proj_all[e, s]`` (host-side) names the original feature id of that slot
+    (-1 padding). Reference: model/RandomEffectModel.scala:36.
+    """
+
+    coefficients: Array  # [E, S]
+    random_effect_type: str
+    feature_shard_id: str
+    task: TaskType
+    proj_all: np.ndarray  # [E, S] original feature ids; -1 pad
+    variances: Array | None = None  # [E, S]
+    entity_keys: tuple = ()
+
+    @property
+    def num_entities(self) -> int:
+        return self.coefficients.shape[0]
+
+    @property
+    def sub_dim(self) -> int:
+        return self.coefficients.shape[1]
+
+    def score_table(
+        self, codes: Array, indices: Array, values: Array
+    ) -> Array:
+        """Scores for rows given subspace-remapped ELL arrays.
+
+        z_i = sum_j values[i, j] * W[codes[i], indices[i, j]] — the
+        RandomEffectModel.score join (:70) as a fused two-level gather.
+        """
+        return score_entity_table(self.coefficients, codes, indices, values)
+
+    def score_dataset(self, dataset: RandomEffectDataset) -> Array:
+        return self.score_table(
+            dataset.score_codes, dataset.score_indices, dataset.score_values
+        )
+
+
+def score_entity_table(
+    w: Array, codes: Array, indices: Array, values: Array
+) -> Array:
+    """z_i = sum_j values[i,j] * w[codes[i], indices[i,j]] (jit-friendly)."""
+    rows = jnp.take(w, codes, axis=0)  # [n, S]
+    picked = jnp.take_along_axis(rows, indices, axis=-1)  # [n, k]
+    return jnp.sum(values * picked, axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Ordered composite of coordinate sub-models (model/GameModel.scala:32).
+
+    Iteration order is the coordinate update sequence; total score is the sum
+    of per-coordinate scores (DataScores ``+`` algebra).
+    """
+
+    models: dict[str, FixedEffectModel | RandomEffectModel]
+
+    def __getitem__(self, coordinate_id: str):
+        return self.models[coordinate_id]
+
+    def __contains__(self, coordinate_id: str) -> bool:
+        return coordinate_id in self.models
+
+    def items(self):
+        return self.models.items()
+
+    def updated(self, coordinate_id: str, model) -> "GameModel":
+        new = dict(self.models)
+        new[coordinate_id] = model
+        return GameModel(new)
+
+    @property
+    def task(self) -> TaskType:
+        for m in self.models.values():
+            return m.task
+        raise ValueError("empty GAME model")
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseEntityCoefficients:
+    """One entity's model in original-space sparse form: parallel arrays of
+    (original feature id, mean[, variance]) — the shape of one per-entity
+    BayesianLinearModelAvro record."""
+
+    feature_indices: np.ndarray  # [nnz] original feature ids
+    means: np.ndarray  # [nnz]
+    variances: np.ndarray | None  # [nnz]
+
+
+def random_effect_model_to_glms(
+    model: RandomEffectModel,
+) -> dict[str, SparseEntityCoefficients]:
+    """Expand the padded matrix into per-entity original-space sparse
+    coefficients (for model export parity with the reference's per-entity
+    BayesianLinearModelAvro records). The subspace slot order is compacted
+    away; ``feature_indices`` names each mean's original feature id."""
+    out: dict[str, SparseEntityCoefficients] = {}
+    w = np.asarray(model.coefficients)
+    v = None if model.variances is None else np.asarray(model.variances)
+    for e in range(model.num_entities):
+        valid = model.proj_all[e] >= 0
+        if not valid.any():
+            continue
+        key = model.entity_keys[e] if model.entity_keys else str(e)
+        out[str(key)] = SparseEntityCoefficients(
+            feature_indices=model.proj_all[e, valid].astype(np.int64),
+            means=w[e, valid],
+            variances=None if v is None else v[e, valid],
+        )
+    return out

@@ -26,6 +26,8 @@ from photon_tpu.optim.regularization import (
     RegularizationType,
     with_l2,
     with_l2_hvp,
+    with_l2_hvp_masked,
+    with_l2_masked,
 )
 from photon_tpu.optim.tron import tron_solve
 
@@ -47,6 +49,8 @@ __all__ = [
     "tron_solve",
     "with_l2",
     "with_l2_hvp",
+    "with_l2_hvp_masked",
+    "with_l2_masked",
 ]
 
 

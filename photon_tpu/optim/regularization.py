@@ -83,6 +83,39 @@ def _l2_mask(w: Array, intercept_index: int | None) -> Array:
     return w.at[intercept_index].set(0.0)
 
 
+def with_l2_masked(
+    fun: ValueAndGrad,
+    l2_weight,
+    penalty_mask: Array,
+) -> ValueAndGrad:
+    """``with_l2`` with an array penalty mask instead of a static intercept
+    index — the batched (vmapped) form used by random-effect coordinates,
+    where each entity has its own intercept slot and its own set of valid
+    (non-padding) subspace slots. ``penalty_mask`` is 1 for penalized
+    coefficients, 0 for the intercept and padded slots.
+    """
+
+    def wrapped(w: Array):
+        f, g = fun(w)
+        wm = w * penalty_mask
+        return f + 0.5 * l2_weight * jnp.dot(wm, wm), g + l2_weight * wm
+
+    return wrapped
+
+
+def with_l2_hvp_masked(
+    hvp: HessianVectorProduct,
+    l2_weight,
+    penalty_mask: Array,
+) -> HessianVectorProduct:
+    """Masked-array counterpart of ``with_l2_hvp`` (see ``with_l2_masked``)."""
+
+    def wrapped(w: Array, d: Array):
+        return hvp(w, d) + l2_weight * (d * penalty_mask)
+
+    return wrapped
+
+
 def with_l2(
     fun: ValueAndGrad,
     l2_weight,
