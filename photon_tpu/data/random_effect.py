@@ -204,6 +204,87 @@ def _pearson_select(
     return np.sort(active_features[order])
 
 
+def _build_score_table(
+    codes: np.ndarray,  # [n] entity codes into projs; -1 = no entity
+    ell_idx: np.ndarray,  # [n, k_in]
+    ell_val: np.ndarray,  # [n, k_in]
+    projs_of,  # callable e -> [s_e] sorted original feature ids
+    num_entities: int,
+    num_features: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Shared scoring-table remap: every row's ELL entries mapped into its
+    owning entity's subspace (dropped features zeroed). Used by the dataset
+    build (active+passive rows) and by ``remap_for_scoring`` (new data)."""
+    n = codes.shape[0]
+    k_all = max(int((ell_val != 0.0).sum(axis=1).max(initial=0)), 1)
+    si = np.zeros((n, k_all), dtype=np.int32)
+    sv = np.zeros((n, k_all), dtype=ell_val.dtype)
+    order = np.argsort(codes, kind="stable")
+    sorted_codes = codes[order]
+    starts = np.searchsorted(sorted_codes, np.arange(num_entities))
+    ends = np.searchsorted(sorted_codes, np.arange(num_entities), side="right")
+    lut = np.full(num_features, -1, dtype=np.int64)
+    for e in range(num_entities):
+        rows = order[starts[e] : ends[e]]
+        if rows.size == 0:
+            continue
+        p = projs_of(e)
+        lut[p] = np.arange(p.size)
+        si[rows], sv[rows] = _remap_ell_rows(
+            ell_idx[rows], ell_val[rows], lut, k_all
+        )
+        lut[p] = -1
+    return si, sv
+
+
+def remap_for_scoring(
+    game_data: GameDataset,
+    *,
+    re_type: str,
+    feature_shard_id: str,
+    entity_keys: tuple,
+    proj_all: np.ndarray,  # [E, S] original feature ids; -1 pad
+    dtype=None,
+) -> tuple[Array, Array, Array]:
+    """Remap an arbitrary GameDataset's rows into trained entity subspaces.
+
+    Returns (codes, indices, values) consumable by
+    ``RandomEffectModel.score_table`` — the scoring path for validation /
+    test data (RandomEffectModel.score :70 joins new data by REId; entities
+    unseen at training time contribute score 0, matching the reference's
+    left-join semantics where rows without a model get no score).
+    """
+    if dtype is None:
+        dtype = game_data.labels.dtype
+    tag = game_data.id_tags[re_type]
+    vocab = {k: i for i, k in enumerate(entity_keys)}
+    # this-dataset code -> trained code (-1 unseen)
+    code_map = np.array(
+        [vocab.get(k, -1) for k in tag.inverse], dtype=np.int64
+    )
+    codes = code_map[np.asarray(tag.codes)]
+
+    ell_idx, ell_val, num_features = _rows_to_coo(
+        game_data.feature_shards[feature_shard_id]
+    )
+    si, sv = _build_score_table(
+        codes,
+        ell_idx,
+        ell_val,
+        lambda e: proj_all[e][proj_all[e] >= 0],
+        len(entity_keys),
+        num_features,
+    )
+    # Unseen entities: clamp the code and zero the values -> score 0.
+    sv[codes < 0] = 0.0
+    codes_safe = np.maximum(codes, 0)
+    return (
+        jnp.asarray(codes_safe.astype(np.int32)),
+        jnp.asarray(si),
+        jnp.asarray(sv, dtype=dtype),
+    )
+
+
 def build_random_effect_dataset(
     game_data: GameDataset,
     config: RandomEffectDataConfiguration,
@@ -360,23 +441,14 @@ def build_random_effect_dataset(
         )
 
     # --- 4. full-table scoring arrays (active + passive rows) -------------
-    k_all = max(int((ell_val != 0.0).sum(axis=1).max(initial=0)), 1)
-    si = np.zeros((n, k_all), dtype=np.int32)
-    sv = np.zeros((n, k_all), dtype=ell_val.dtype)
-    # Vectorized per entity: all of an entity's rows (active AND passive) are
-    # contiguous in the (entity, hash) sort; one reused lookup buffer keeps
-    # the whole pass O(total nnz).
-    lut = np.full(num_features, -1, dtype=np.int64)
-    for e in range(num_entities):
-        p = projs[e]
-        rows = perm[starts[e] : ends[e]]
-        if rows.size == 0:
-            continue
-        lut[p] = np.arange(p.size)
-        si[rows], sv[rows] = _remap_ell_rows(
-            ell_idx[rows], ell_val[rows], lut, k_all
-        )
-        lut[p] = -1
+    si, sv = _build_score_table(
+        codes.astype(np.int64),
+        ell_idx,
+        ell_val,
+        lambda e: projs[e],
+        num_entities,
+        num_features,
+    )
 
     return RandomEffectDataset(
         config=config,

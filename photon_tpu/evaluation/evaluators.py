@@ -15,8 +15,10 @@ instead of a shuffle.
 Reference formula quirks preserved deliberately (documented for parity):
 - loss evaluators return the weighted SUM of pointwise losses, not a mean
   (LogisticLossEvaluator.scala et al.);
-- SQUARED_LOSS is sum(w * (s-y)^2 / 2), and RMSE = sqrt(squared_loss / n) —
-  i.e. the 1/2 stays inside (RMSEEvaluator.scala);
+- SQUARED_LOSS is sum(w * (s-y)^2): the pointwise loss's convenience 1/2 is
+  undone by the evaluator (SquaredLossEvaluator.scala multiplies by 2), and
+  RMSE = sqrt(squared_loss / n) over the unweighted count
+  (RMSEEvaluator.scala);
 - precision@k divides by k, not by min(k, group size)
   (PrecisionAtKLocalEvaluator.scala:50);
 - AUPR is unweighted, with the (0, firstPrecision) anchor point of Spark's
@@ -117,7 +119,10 @@ def poisson_loss(scores, labels, weights=None) -> Array:
 
 
 def squared_loss(scores, labels, weights=None) -> Array:
-    return _weighted_loss_sum(losses_mod.SQUARED, scores, labels, weights)
+    """sum(w * (s - y)^2). The pointwise loss carries the optimizer's
+    convenience factor 1/2; the evaluator undoes it
+    (SquaredLossEvaluator.scala: ``2 * weight * lossAndDzLoss(...)._1``)."""
+    return 2.0 * _weighted_loss_sum(losses_mod.SQUARED, scores, labels, weights)
 
 
 def smoothed_hinge_loss(scores, labels, weights=None) -> Array:
@@ -125,7 +130,8 @@ def smoothed_hinge_loss(scores, labels, weights=None) -> Array:
 
 
 def rmse(scores, labels, weights=None) -> Array:
-    """Reference formula: sqrt(sum(w * (s-y)^2 / 2) / n)."""
+    """sqrt(sum(w * (s-y)^2) / n) (RMSEEvaluator.scala: squared loss over
+    the unweighted count)."""
     n = scores.shape[0]
     return jnp.sqrt(squared_loss(scores, labels, weights) / n)
 

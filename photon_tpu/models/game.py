@@ -125,6 +125,60 @@ class GameModel:
         raise ValueError("empty GAME model")
 
 
+def remap_random_effect_model(
+    model: RandomEffectModel,
+    *,
+    entity_keys: tuple,
+    proj_all: np.ndarray,
+) -> RandomEffectModel:
+    """Re-layout a RandomEffectModel onto a different dataset layout.
+
+    Used when an externally loaded model (warm start / partial retrain,
+    GameTrainingDriver.scala:395-404) meets a freshly built
+    RandomEffectDataset whose entity vocabulary and per-entity subspace slot
+    order differ from the model's. Coefficients are routed by (entity key,
+    original feature id); entities/features absent from the new layout are
+    dropped, new ones start at zero — the fullOuterJoin warm-start semantics
+    of RandomEffectCoordinate.scala:200.
+    """
+    e_new, s_new = proj_all.shape
+    w_old = np.asarray(model.coefficients)
+    v_old = None if model.variances is None else np.asarray(model.variances)
+    dtype = w_old.dtype
+    w = np.zeros((e_new, s_new), dtype=dtype)
+    v = None if v_old is None else np.zeros((e_new, s_new), dtype=dtype)
+    old_vocab = {k: i for i, k in enumerate(model.entity_keys)}
+    max_feat = 0
+    if proj_all.size:
+        max_feat = max(max_feat, int(proj_all.max(initial=0)))
+    if model.proj_all.size:
+        max_feat = max(max_feat, int(model.proj_all.max(initial=0)))
+    lut = np.full(max_feat + 1, -1, dtype=np.int64)
+    for en, key in enumerate(entity_keys):
+        eo = old_vocab.get(key)
+        if eo is None:
+            continue
+        old_p = model.proj_all[eo]
+        old_valid = old_p >= 0
+        lut[old_p[old_valid]] = np.nonzero(old_valid)[0]
+        new_p = proj_all[en]
+        new_valid = new_p >= 0
+        src = lut[new_p[new_valid]]
+        dst = np.nonzero(new_valid)[0]
+        hit = src >= 0
+        w[en, dst[hit]] = w_old[eo, src[hit]]
+        if v is not None:
+            v[en, dst[hit]] = v_old[eo, src[hit]]
+        lut[old_p[old_valid]] = -1
+    return dataclasses.replace(
+        model,
+        coefficients=jnp.asarray(w),
+        variances=None if v is None else jnp.asarray(v),
+        proj_all=proj_all,
+        entity_keys=entity_keys,
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class SparseEntityCoefficients:
     """One entity's model in original-space sparse form: parallel arrays of

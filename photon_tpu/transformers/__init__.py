@@ -1,0 +1,83 @@
+"""GameTransformer: score new data with a trained GAME model.
+
+TPU-native counterpart of photon-api transformers/GameTransformer.scala:150:
+model + dataset -> per-row scores (ModelDataScores), optionally evaluated.
+The reference's scoreGameDataset (:263-275) broadcasts fixed-effect
+coefficients and joins random-effect models by REId; here both are gathers
+against device-resident model arrays, and sub-model scores sum elementwise
+(ModelDataScores ``+`` algebra).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from photon_tpu.data.game_data import GameDataset
+from photon_tpu.data.random_effect import remap_for_scoring
+from photon_tpu.evaluation.evaluators import EvaluatorSpec
+from photon_tpu.evaluation.suite import EvaluationResults, make_suite
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class GameTransformer:
+    """Reference: transformers/GameTransformer.scala (transform :150-197)."""
+
+    model: GameModel
+
+    def score(self, data: GameDataset) -> Array:
+        """Summed sub-model scores per row — the raw model contribution, no
+        offset (GameModel.score semantics; offsets are added by evaluation
+        and by downstream consumers, EvaluationSuite.scala:62-66)."""
+        total = None
+        for cid, m in self.model.items():
+            if isinstance(m, RandomEffectModel):
+                codes, idx, vals = remap_for_scoring(
+                    data,
+                    re_type=m.random_effect_type,
+                    feature_shard_id=m.feature_shard_id,
+                    entity_keys=m.entity_keys,
+                    proj_all=m.proj_all,
+                )
+                s = m.score_table(codes, idx, vals)
+            elif isinstance(m, FixedEffectModel):
+                s = m.model.coefficients.compute_score(
+                    data.feature_shards[m.feature_shard_id]
+                )
+            else:
+                raise TypeError(f"unknown sub-model type for {cid!r}: {m}")
+            total = s if total is None else total + s
+        if total is None:
+            raise ValueError("empty GAME model")
+        return total
+
+    def transform(
+        self,
+        data: GameDataset,
+        evaluators: list[str | EvaluatorSpec] | None = None,
+    ) -> tuple[Array, EvaluationResults | None]:
+        """Score; optionally evaluate against the dataset's labels
+        (GameTransformer validation path :186-192)."""
+        scores = self.score(data)
+        if not evaluators:
+            return scores, None
+        suite = make_suite(
+            evaluators,
+            data.labels,
+            offsets=data.offsets,
+            weights=data.weights,
+            group_ids={
+                name: (tag.codes, tag.num_groups)
+                for name, tag in data.id_tags.items()
+            },
+            dtype=data.labels.dtype,
+        )
+        return scores, suite.evaluate(scores)

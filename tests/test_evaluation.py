@@ -60,7 +60,9 @@ def test_rmse_reference_formula(rng):
     s = y + rng.normal(size=50)
     w = rng.uniform(0.5, 2.0, size=50)
     got = float(ev.rmse(jnp.asarray(s), jnp.asarray(y), jnp.asarray(w)))
-    want = np.sqrt(np.sum(w * (s - y) ** 2 / 2) / 50)  # reference quirk: /2 inside
+    # SquaredLossEvaluator.scala undoes the pointwise 1/2 (2 * w * loss);
+    # RMSEEvaluator divides by the unweighted count.
+    want = np.sqrt(np.sum(w * (s - y) ** 2) / 50)
     assert got == pytest.approx(want, rel=1e-12)
 
 

@@ -1,0 +1,327 @@
+"""GAME end-to-end: coordinate descent, estimator, transformer.
+
+Mirrors the reference's CoordinateDescentIntegTest (residual bookkeeping with
+scripted coordinates) and GameEstimatorIntegTest / GameTrainingDriverIntegTest
+(synthetic GLMix fit with a frozen metric threshold — the Yahoo! Music
+RMSE < 1.697 pattern, GameTrainingDriverIntegTest.scala:78-79).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu import optim
+from photon_tpu.algorithm.coordinate_descent import (
+    CoordinateDescent,
+    ValidationContext,
+)
+from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
+from photon_tpu.data.dataset import DenseFeatures
+from photon_tpu.data.game_data import make_game_dataset
+from photon_tpu.data.random_effect import RandomEffectDataConfiguration
+from photon_tpu.estimators.game_estimator import (
+    FixedEffectCoordinateConfiguration,
+    GameEstimator,
+    RandomEffectCoordinateConfiguration,
+)
+from photon_tpu.evaluation.suite import make_suite
+from photon_tpu.models.game import GameModel
+from photon_tpu.transformers import GameTransformer
+from photon_tpu.types import TaskType
+
+
+@dataclasses.dataclass
+class ScriptedCoordinate:
+    """Mock coordinate recording the residuals it was trained against
+    (the Mockito pattern of CoordinateDescentIntegTest)."""
+
+    n: int
+    contribution: float
+    trained_residuals: list = dataclasses.field(default_factory=list)
+
+    def train(self, residuals=None, initial_model=None, *, seed=0):
+        self.trained_residuals.append(
+            None if residuals is None else np.asarray(residuals)
+        )
+        return {"c": self.contribution}, None
+
+    def score(self, model):
+        return jnp.full(self.n, model["c"], dtype=jnp.float64)
+
+
+class TestCoordinateDescentBookkeeping:
+    def test_residual_sequence(self):
+        """Coordinate k must see exactly the sum of the OTHER coordinates'
+        latest scores (CoordinateDescent.scala:442,583)."""
+        n = 5
+        a = ScriptedCoordinate(n, 1.0)
+        b = ScriptedCoordinate(n, 10.0)
+        c = ScriptedCoordinate(n, 100.0)
+        cd = CoordinateDescent(["a", "b", "c"], num_iterations=2)
+        result = cd.run({"a": a, "b": b, "c": c})
+
+        # iteration 0: a sees nothing; b sees a=1; c sees a+b=11
+        assert a.trained_residuals[0] is None
+        np.testing.assert_allclose(b.trained_residuals[0], 1.0)
+        np.testing.assert_allclose(c.trained_residuals[0], 11.0)
+        # iteration 1: a sees b+c=110; b sees a+c=101; c sees a+b=11
+        np.testing.assert_allclose(a.trained_residuals[1], 110.0)
+        np.testing.assert_allclose(b.trained_residuals[1], 101.0)
+        np.testing.assert_allclose(c.trained_residuals[1], 11.0)
+        assert set(result.model.models) == {"a", "b", "c"}
+
+    def test_locked_coordinates_score_but_do_not_train(self):
+        n = 4
+        a = ScriptedCoordinate(n, 1.0)
+        b = ScriptedCoordinate(n, 10.0)
+        cd = CoordinateDescent(
+            ["a", "b"], num_iterations=2, locked_coordinates={"a"}
+        )
+        result = cd.run({"a": a, "b": b}, initial_models={"a": {"c": 7.0}})
+        assert a.trained_residuals == []  # never retrained
+        # b always sees a's locked contribution 7
+        np.testing.assert_allclose(b.trained_residuals[0], 7.0)
+        np.testing.assert_allclose(b.trained_residuals[1], 7.0)
+        assert result.model["a"] == {"c": 7.0}
+
+    def test_locked_requires_model(self):
+        with pytest.raises(ValueError, match="needs an initial model"):
+            CoordinateDescent(
+                ["a", "b"], 1, locked_coordinates={"a"}
+            ).run({"a": ScriptedCoordinate(3, 1.0),
+                   "b": ScriptedCoordinate(3, 2.0)})
+
+    def test_all_locked_rejected(self):
+        with pytest.raises(ValueError, match="no trainable"):
+            CoordinateDescent(["a"], 1, locked_coordinates={"a"})
+
+    def test_best_model_tracking(self):
+        """Validation tracks the best model across updates even if later
+        updates are worse (descendWithValidation best-model logic)."""
+        n = 4
+        labels = jnp.asarray(np.array([1.0, 2.0, 3.0, 4.0]))
+        suite = make_suite(["RMSE"], labels)
+
+        class DriftingCoordinate(ScriptedCoordinate):
+            """Each retrain drifts further from the labels."""
+
+            def train(self, residuals=None, initial_model=None, *, seed=0):
+                self.contribution += 10.0
+                return super().train(residuals, initial_model, seed=seed)
+
+        coord = DriftingCoordinate(n, 0.0)
+        cd = CoordinateDescent(["a"], num_iterations=3)
+        val = ValidationContext(
+            suite=suite,
+            scorers={"a": lambda m: jnp.full(n, m["c"], dtype=jnp.float64)},
+        )
+        result = cd.run({"a": coord}, validation=val)
+        # contributions were 10, 20, 30; labels mean 2.5 -> 10 is best
+        assert result.best_model["a"] == {"c": 10.0}
+        assert result.model["a"] == {"c": 30.0}
+        assert result.best_evaluation is not None
+        assert len(result.history) == 3
+
+
+def _glmix_data(rng, n, num_users, num_items, noise=0.1, seed_shift=0):
+    """Synthetic MovieLens-shaped GLMix data: global features + per-user and
+    per-item intercept-ish effects."""
+    d = 5
+    x = rng.normal(size=(n, d))
+    x[:, -1] = 1.0
+    users = rng.integers(0, num_users, size=n)
+    items = rng.integers(0, num_items, size=n)
+    w_global = np.array([1.0, -0.5, 0.25, 0.8, 0.3])
+    u_eff = rng.normal(scale=1.0, size=num_users)
+    i_eff = rng.normal(scale=0.5, size=num_items)
+    z = x @ w_global + u_eff[users] + i_eff[items]
+    y = z + noise * rng.normal(size=n)
+    game = make_game_dataset(
+        y,
+        {
+            "global": DenseFeatures(jnp.asarray(x)),
+            "bias": DenseFeatures(jnp.ones((n, 1))),
+        },
+        id_tags={
+            "userId": np.array([f"u{u}" for u in users]),
+            "movieId": np.array([f"m{i}" for i in items]),
+        },
+        dtype=jnp.float64,
+    )
+    return game, z
+
+
+class TestGameEstimatorGLMix:
+    def _estimator(self, num_iterations=3):
+        return GameEstimator(
+            TaskType.LINEAR_REGRESSION,
+            {
+                "global": FixedEffectCoordinateConfiguration(
+                    "global",
+                    GLMOptimizationConfiguration(
+                        regularization=optim.RegularizationContext(
+                            optim.RegularizationType.L2
+                        ),
+                        regularization_weight=1e-3,
+                    ),
+                ),
+                "per-user": RandomEffectCoordinateConfiguration(
+                    RandomEffectDataConfiguration("userId", "bias"),
+                    GLMOptimizationConfiguration(
+                        regularization=optim.RegularizationContext(
+                            optim.RegularizationType.L2
+                        ),
+                        regularization_weight=1.0,
+                    ),
+                ),
+                "per-movie": RandomEffectCoordinateConfiguration(
+                    RandomEffectDataConfiguration("movieId", "bias"),
+                    GLMOptimizationConfiguration(
+                        regularization=optim.RegularizationContext(
+                            optim.RegularizationType.L2
+                        ),
+                        regularization_weight=1.0,
+                    ),
+                ),
+            },
+            intercept_indices={"global": 4, "bias": 0},
+            num_iterations=num_iterations,
+        )
+
+    def test_glmix_end_to_end_rmse(self, rng):
+        """Frozen-threshold e2e (the Yahoo! Music RMSE < 1.697 pattern):
+        GLMix must beat the fixed-effect-only model and approach the noise
+        floor on synthetic data."""
+        # Split one generated dataset so train/validation share the same
+        # entity effect draws.
+        full, z = _glmix_data(rng, 4000, 40, 15)
+        labels = np.asarray(full.labels)
+        tr, va = np.arange(3000), np.arange(3000, 4000)
+
+        def subset(idx):
+            return make_game_dataset(
+                labels[idx],
+                {
+                    "global": DenseFeatures(
+                        full.feature_shards["global"].x[idx]),
+                    "bias": DenseFeatures(full.feature_shards["bias"].x[idx]),
+                },
+                id_tags={
+                    name: np.asarray(tag.inverse)[np.asarray(tag.codes)[idx]]
+                    for name, tag in full.id_tags.items()
+                },
+                dtype=jnp.float64,
+            )
+
+        train, val = subset(tr), subset(va)
+        est = self._estimator()
+        results = est.fit(train, val)
+        assert len(results) == 1
+        r = results[0]
+        glmix_rmse = r.evaluation.evaluations["RMSE"]
+
+        # Fixed-effect-only baseline on the same data.
+        fe_only = GameEstimator(
+            TaskType.LINEAR_REGRESSION,
+            {"global": FixedEffectCoordinateConfiguration("global")},
+            intercept_indices={"global": 4},
+        )
+        fe_rmse = fe_only.fit(train, val)[0].evaluation.evaluations["RMSE"]
+
+        # Mixed effects must explain the per-entity variance.
+        assert glmix_rmse < fe_rmse * 0.6, (glmix_rmse, fe_rmse)
+        assert glmix_rmse < 0.5, glmix_rmse  # noise=0.1, u/i effects ~N(0,1)
+
+    def test_warm_start_across_lambda_configs(self, rng):
+        train, _ = _glmix_data(rng, 1500, 20, 8)
+        est = self._estimator(num_iterations=1)
+        base = est.coordinate_configs["per-user"].optimization
+        seq = [
+            {"per-user": base.with_regularization_weight(lam)}
+            for lam in (10.0, 1.0, 0.1)
+        ]
+        results = est.fit(train, opt_config_sequence=seq)
+        assert len(results) == 3
+        assert [r.config["per-user"].regularization_weight
+                for r in results] == [10.0, 1.0, 0.1]
+        # Stronger regularization -> smaller per-user coefficients.
+        norms = [
+            float(jnp.abs(r.model["per-user"].coefficients).sum())
+            for r in results
+        ]
+        assert norms[0] < norms[1] < norms[2]
+
+    def test_transformer_matches_validation_scores(self, rng):
+        train, _ = _glmix_data(rng, 1500, 20, 8)
+        est = self._estimator(num_iterations=2)
+        result = est.fit(train)[0]
+        scores, evaluation = GameTransformer(result.model).transform(
+            train, evaluators=["RMSE"]
+        )
+        assert scores.shape == (1500,)
+        assert evaluation.evaluations["RMSE"] < 1.0
+        # Unseen entities score only the fixed effect (no crash).
+        other, _ = _glmix_data(
+            np.random.default_rng(999), 50, 100, 50
+        )
+        s2 = GameTransformer(result.model).score(other)
+        assert np.isfinite(np.asarray(s2)).all()
+
+    def test_external_model_remap_across_datasets(self, rng):
+        """A model trained on one dataset must warm-start a fit on DIFFERENT
+        data: entity vocabularies and subspace layouts are re-routed by
+        (entity key, feature id), not trusted positionally."""
+        from photon_tpu.models.game import remap_random_effect_model
+
+        d1, _ = _glmix_data(rng, 1200, 15, 6)
+        est = self._estimator(num_iterations=1)
+        first = est.fit(d1)[0]
+        m = first.model["per-user"]
+
+        # New data: overlapping but differently-coded entity population.
+        d2, _ = _glmix_data(rng, 800, 25, 6)
+        from photon_tpu.data.random_effect import (
+            build_random_effect_dataset,
+        )
+        ds2 = build_random_effect_dataset(
+            d2,
+            est.coordinate_configs["per-user"].data,
+            intercept_index=0,
+        )
+        remapped = remap_random_effect_model(
+            m, entity_keys=ds2.entity_keys, proj_all=ds2.proj_all
+        )
+        assert remapped.num_entities == ds2.num_entities
+        # Shared entities keep their coefficient values, keyed by entity key.
+        old_vocab = {k: i for i, k in enumerate(m.entity_keys)}
+        hits = 0
+        for en, key in enumerate(ds2.entity_keys):
+            if key in old_vocab:
+                hits += 1
+                np.testing.assert_allclose(
+                    float(remapped.coefficients[en, 0]),
+                    float(m.coefficients[old_vocab[key], 0]),
+                )
+        assert hits > 0
+        # And the full fit-with-initial-model path runs end to end.
+        second = est.fit(d2, initial_model=first.model)
+        assert len(second) == 1
+
+    def test_partial_retrain_locked_coordinate(self, rng):
+        train, _ = _glmix_data(rng, 1200, 15, 6)
+        est = self._estimator(num_iterations=1)
+        first = est.fit(train)[0]
+
+        locked_est = self._estimator(num_iterations=2)
+        locked_est.locked_coordinates = {"global"}
+        second = locked_est.fit(
+            train, initial_model=first.model
+        )[0]
+        # Locked coordinate's model is passed through unchanged.
+        np.testing.assert_array_equal(
+            np.asarray(second.model["global"].model.coefficients.means),
+            np.asarray(first.model["global"].model.coefficients.means),
+        )
+        assert isinstance(second.model, GameModel)
