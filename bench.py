@@ -1,0 +1,125 @@
+"""GLMix end-to-end training benchmark (the BASELINE.json headline workload).
+
+Workload: synthetic MovieLens-shaped GLMix — a dense global fixed effect plus
+per-user and per-movie random effects, squared loss, trained by block
+coordinate descent (global L-BFGS solve + vmapped per-entity bucket solves),
+matching BASELINE.json's "MovieLens GLMix (global + per-user + per-movie)"
+config. The first fit warms XLA's compile caches; the timed fit measures
+steady-state training wall-clock.
+
+Metric: training throughput in rows/s (dataset rows x CD iterations /
+wall-clock). ``vs_baseline`` divides by a frozen anchor: the reference
+publishes no wall-clock numbers anywhere (see BASELINE.md), so the anchor is
+a nominal Spark-local-equivalent constant fixed in round 1; cross-round
+movement of this ratio is the signal.
+
+Prints exactly ONE JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+# Frozen round-1 anchor (see module docstring). Nominal Spark local[*]
+# throughput on a comparable GLMix workload; the reference repo itself
+# publishes no benchmark numbers.
+ANCHOR_ROWS_PER_SEC = 50_000.0
+
+N_ROWS = 100_000
+N_FEATURES = 64
+N_USERS = 2_000
+N_MOVIES = 500
+CD_ITERATIONS = 2
+
+
+def build_data():
+    import jax.numpy as jnp
+
+    from photon_tpu.data.dataset import DenseFeatures
+    from photon_tpu.data.game_data import make_game_dataset
+
+    rng = np.random.default_rng(20260729)
+    x = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    x[:, -1] = 1.0
+    users = rng.integers(0, N_USERS, size=N_ROWS)
+    movies = rng.integers(0, N_MOVIES, size=N_ROWS)
+    w = rng.normal(size=N_FEATURES).astype(np.float32) * 0.3
+    u_eff = rng.normal(size=N_USERS).astype(np.float32)
+    m_eff = rng.normal(size=N_MOVIES).astype(np.float32) * 0.5
+    y = (
+        x @ w
+        + u_eff[users]
+        + m_eff[movies]
+        + 0.2 * rng.normal(size=N_ROWS).astype(np.float32)
+    )
+    return make_game_dataset(
+        y,
+        {
+            "global": DenseFeatures(jnp.asarray(x)),
+            "bias": DenseFeatures(jnp.ones((N_ROWS, 1), dtype=jnp.float32)),
+        },
+        id_tags={"userId": users, "movieId": movies},
+    )
+
+
+def build_estimator():
+    from photon_tpu import optim
+    from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
+    from photon_tpu.data.random_effect import RandomEffectDataConfiguration
+    from photon_tpu.estimators.game_estimator import (
+        FixedEffectCoordinateConfiguration,
+        GameEstimator,
+        RandomEffectCoordinateConfiguration,
+    )
+    from photon_tpu.types import TaskType
+
+    def l2(w):
+        return GLMOptimizationConfiguration(
+            regularization=optim.RegularizationContext(
+                optim.RegularizationType.L2
+            ),
+            regularization_weight=w,
+        )
+
+    return GameEstimator(
+        TaskType.LINEAR_REGRESSION,
+        {
+            "global": FixedEffectCoordinateConfiguration("global", l2(1e-3)),
+            "per-user": RandomEffectCoordinateConfiguration(
+                RandomEffectDataConfiguration(
+                    "userId", "bias", active_data_upper_bound=512
+                ),
+                l2(1.0),
+            ),
+            "per-movie": RandomEffectCoordinateConfiguration(
+                RandomEffectDataConfiguration(
+                    "movieId", "bias", active_data_upper_bound=2048
+                ),
+                l2(1.0),
+            ),
+        },
+        intercept_indices={"global": N_FEATURES - 1, "bias": 0},
+        num_iterations=CD_ITERATIONS,
+    )
+
+
+def main():
+    data = build_data()
+    est = build_estimator()
+    est.fit(data)  # warm-up: compile everything
+    t0 = time.perf_counter()
+    results = est.fit(data)
+    seconds = time.perf_counter() - t0
+    del results
+    rows_per_sec = N_ROWS * CD_ITERATIONS / seconds
+    print(json.dumps({
+        "metric": "glmix_e2e_train_throughput",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / ANCHOR_ROWS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -211,19 +211,35 @@ def _build_score_table(
     projs_of,  # callable e -> [s_e] sorted original feature ids
     num_entities: int,
     num_features: int,
+    sort: tuple[np.ndarray, np.ndarray, np.ndarray] | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Shared scoring-table remap: every row's ELL entries mapped into its
     owning entity's subspace (dropped features zeroed). Used by the dataset
-    build (active+passive rows) and by ``remap_for_scoring`` (new data)."""
+    build (active+passive rows) and by ``remap_for_scoring`` (new data).
+    ``sort`` optionally supplies a precomputed (order, starts, ends)
+    entity grouping to skip the argsort."""
     n = codes.shape[0]
     k_all = max(int((ell_val != 0.0).sum(axis=1).max(initial=0)), 1)
     si = np.zeros((n, k_all), dtype=np.int32)
     sv = np.zeros((n, k_all), dtype=ell_val.dtype)
-    order = np.argsort(codes, kind="stable")
-    sorted_codes = codes[order]
-    starts = np.searchsorted(sorted_codes, np.arange(num_entities))
-    ends = np.searchsorted(sorted_codes, np.arange(num_entities), side="right")
-    lut = np.full(num_features, -1, dtype=np.int64)
+    if sort is not None:
+        order, starts, ends = sort
+    else:
+        order = np.argsort(codes, kind="stable")
+        sorted_codes = codes[order]
+        starts = np.searchsorted(sorted_codes, np.arange(num_entities))
+        ends = np.searchsorted(
+            sorted_codes, np.arange(num_entities), side="right"
+        )
+    # A trained model's projectors may reference feature ids beyond this
+    # dataset's shard dimension; size the LUT to cover both so unknown
+    # features are dropped, not crashed on.
+    lut_size = num_features
+    for e in range(num_entities):
+        p = projs_of(e)
+        if p.size:
+            lut_size = max(lut_size, int(p.max()) + 1)
+    lut = np.full(lut_size, -1, dtype=np.int64)
     for e in range(num_entities):
         rows = order[starts[e] : ends[e]]
         if rows.size == 0:
@@ -448,6 +464,7 @@ def build_random_effect_dataset(
         lambda e: projs[e],
         num_entities,
         num_features,
+        sort=(perm, starts, ends),  # reuse the (entity, hash) lexsort
     )
 
     return RandomEffectDataset(

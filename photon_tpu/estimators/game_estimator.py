@@ -37,7 +37,10 @@ from photon_tpu.data.game_data import GameDataset
 from photon_tpu.data.random_effect import (
     RandomEffectDataConfiguration,
     build_random_effect_dataset,
-    remap_for_scoring,
+)
+from photon_tpu.transformers import (
+    fixed_effect_scorer,
+    random_effect_scorer,
 )
 from photon_tpu.evaluation.evaluators import EvaluatorSpec
 from photon_tpu.evaluation.suite import EvaluationResults, make_suite
@@ -251,25 +254,17 @@ class GameEstimator:
         for cid, cfg in self.coordinate_configs.items():
             if isinstance(cfg, RandomEffectCoordinateConfiguration):
                 ds = datasets[cid]
-                codes, idx, vals = remap_for_scoring(
+                scorers[cid] = random_effect_scorer(
                     validation,
                     re_type=cfg.data.random_effect_type,
                     feature_shard_id=cfg.data.feature_shard_id,
                     entity_keys=ds.entity_keys,
                     proj_all=ds.proj_all,
                 )
-
-                def re_scorer(m, codes=codes, idx=idx, vals=vals):
-                    return m.score_table(codes, idx, vals)
-
-                scorers[cid] = re_scorer
             else:
-                feats = validation.feature_shards[cfg.feature_shard_id]
-
-                def fe_scorer(m, feats=feats):
-                    return m.model.coefficients.compute_score(feats)
-
-                scorers[cid] = fe_scorer
+                scorers[cid] = fixed_effect_scorer(
+                    validation, cfg.feature_shard_id
+                )
         return ValidationContext(suite=suite, scorers=scorers)
 
     # ------------------------------------------------------------------
@@ -333,8 +328,12 @@ class GameEstimator:
             logger.info(
                 "GameEstimator: config %d/%d", i + 1, len(opt_config_sequence)
             )
+            # Injective seed spacing: CD uses seed+iteration internally, so
+            # stride by num_iterations to keep down-sampling draws
+            # independent across the lambda-config grid.
             descent = cd.run(
-                coords, initial_models or None, val_ctx, seed=i
+                coords, initial_models or None, val_ctx,
+                seed=i * self.num_iterations,
             )
             full_config = {
                 cid: opt_configs.get(cid, self.coordinate_configs[cid].optimization)

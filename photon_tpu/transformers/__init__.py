@@ -27,6 +27,58 @@ from photon_tpu.models.game import (
 Array = jax.Array
 
 
+def fixed_effect_scorer(data: GameDataset, feature_shard_id: str):
+    """model -> per-row scores for a fixed-effect sub-model on ``data``."""
+    feats = data.feature_shards[feature_shard_id]
+
+    def scorer(m: FixedEffectModel) -> Array:
+        return m.model.coefficients.compute_score(feats)
+
+    return scorer
+
+
+def random_effect_scorer(
+    data: GameDataset,
+    *,
+    re_type: str,
+    feature_shard_id: str,
+    entity_keys: tuple,
+    proj_all,
+):
+    """model -> per-row scores for a random-effect sub-model on ``data``.
+
+    The expensive host-side subspace remap happens once at construction;
+    the returned closure is a pure device gather.
+    """
+    codes, idx, vals = remap_for_scoring(
+        data,
+        re_type=re_type,
+        feature_shard_id=feature_shard_id,
+        entity_keys=entity_keys,
+        proj_all=proj_all,
+    )
+
+    def scorer(m: RandomEffectModel) -> Array:
+        return m.score_table(codes, idx, vals)
+
+    return scorer
+
+
+def make_submodel_scorer(sub_model, data: GameDataset):
+    """Dispatch a scorer for one trained sub-model (GameModel.score arm)."""
+    if isinstance(sub_model, RandomEffectModel):
+        return random_effect_scorer(
+            data,
+            re_type=sub_model.random_effect_type,
+            feature_shard_id=sub_model.feature_shard_id,
+            entity_keys=sub_model.entity_keys,
+            proj_all=sub_model.proj_all,
+        )
+    if isinstance(sub_model, FixedEffectModel):
+        return fixed_effect_scorer(data, sub_model.feature_shard_id)
+    raise TypeError(f"unknown sub-model type: {sub_model}")
+
+
 @dataclasses.dataclass(frozen=True)
 class GameTransformer:
     """Reference: transformers/GameTransformer.scala (transform :150-197)."""
@@ -38,22 +90,8 @@ class GameTransformer:
         offset (GameModel.score semantics; offsets are added by evaluation
         and by downstream consumers, EvaluationSuite.scala:62-66)."""
         total = None
-        for cid, m in self.model.items():
-            if isinstance(m, RandomEffectModel):
-                codes, idx, vals = remap_for_scoring(
-                    data,
-                    re_type=m.random_effect_type,
-                    feature_shard_id=m.feature_shard_id,
-                    entity_keys=m.entity_keys,
-                    proj_all=m.proj_all,
-                )
-                s = m.score_table(codes, idx, vals)
-            elif isinstance(m, FixedEffectModel):
-                s = m.model.coefficients.compute_score(
-                    data.feature_shards[m.feature_shard_id]
-                )
-            else:
-                raise TypeError(f"unknown sub-model type for {cid!r}: {m}")
+        for _, m in self.model.items():
+            s = make_submodel_scorer(m, data)(m)
             total = s if total is None else total + s
         if total is None:
             raise ValueError("empty GAME model")
