@@ -1,0 +1,381 @@
+"""Pure-Python Avro: binary codec + Object Container Files.
+
+The environment ships no Avro library, and the reference's model/data
+formats are Avro (photon-avro-schemas/src/main/avro/*.avsc,
+AvroUtils.scala:62 readAvroFiles, ModelProcessingUtils.scala:77). This
+module implements the subset of the Avro 1.x specification those schemas
+need — null/boolean/int/long/float/double/string/bytes primitives, records,
+arrays, maps, unions, enums, fixed, named-type references — plus the object
+container file format (magic ``Obj\\x01``, metadata map with schema JSON and
+codec, 16-byte sync markers, null/deflate codecs), so model files round-trip
+with the reference's readers bit-compatibly.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+_PRIMITIVES = {
+    "null", "boolean", "int", "long", "float", "double", "bytes", "string"
+}
+
+
+class Schema:
+    """Parsed Avro schema with a named-type registry for references."""
+
+    def __init__(self, schema, names: dict | None = None):
+        self.names: dict[str, dict] = {} if names is None else names
+        self.root = self._parse(schema)
+
+    def _parse(self, s):
+        if isinstance(s, str):
+            if s in _PRIMITIVES:
+                return s
+            if s in self.names:
+                return self.names[s]
+            raise ValueError(f"unknown type name {s!r}")
+        if isinstance(s, list):  # union
+            return [self._parse(b) for b in s]
+        if isinstance(s, dict):
+            t = s.get("type")
+            if t in _PRIMITIVES and len(s) == 1:
+                return t
+            if t in ("record", "error"):
+                out = {
+                    "type": "record",
+                    "name": s["name"],
+                    "fields": [],
+                }
+                self._register(s, out)
+                for f in s["fields"]:
+                    out["fields"].append({
+                        "name": f["name"],
+                        "type": self._parse(f["type"]),
+                        "default": f.get("default"),
+                    })
+                return out
+            if t == "enum":
+                out = {"type": "enum", "name": s["name"],
+                       "symbols": list(s["symbols"])}
+                self._register(s, out)
+                return out
+            if t == "fixed":
+                out = {"type": "fixed", "name": s["name"],
+                       "size": int(s["size"])}
+                self._register(s, out)
+                return out
+            if t == "array":
+                return {"type": "array", "items": self._parse(s["items"])}
+            if t == "map":
+                return {"type": "map", "values": self._parse(s["values"])}
+            if isinstance(t, (dict, list)):
+                return self._parse(t)
+            if isinstance(t, str):
+                return self._parse(t)
+        raise ValueError(f"cannot parse schema fragment: {s!r}")
+
+    def _register(self, raw, parsed):
+        name = raw["name"]
+        ns = raw.get("namespace")
+        full = f"{ns}.{name}" if ns and "." not in name else name
+        parsed["fullname"] = full
+        self.names[full] = parsed
+        self.names[name] = parsed
+
+
+# --------------------------------------------------------------------------
+# binary encoding
+# --------------------------------------------------------------------------
+
+
+def _write_long(buf: io.BytesIO, n: int) -> None:
+    n = (n << 1) ^ (n >> 63)  # zigzag
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            return
+
+
+def _read_long(buf) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated varint")
+        byte = b[0]
+        acc |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)  # un-zigzag
+
+
+def _read_exact(buf, n: int) -> bytes:
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError(f"truncated input: wanted {n} bytes, got {len(data)}")
+    return data
+
+
+def _encode(buf: io.BytesIO, schema, datum) -> None:
+    if isinstance(schema, str):
+        if schema == "null":
+            return
+        if schema == "boolean":
+            buf.write(b"\x01" if datum else b"\x00")
+        elif schema in ("int", "long"):
+            _write_long(buf, int(datum))
+        elif schema == "float":
+            buf.write(struct.pack("<f", float(datum)))
+        elif schema == "double":
+            buf.write(struct.pack("<d", float(datum)))
+        elif schema == "string":
+            raw = datum.encode("utf-8")
+            _write_long(buf, len(raw))
+            buf.write(raw)
+        elif schema == "bytes":
+            _write_long(buf, len(datum))
+            buf.write(datum)
+        else:
+            raise ValueError(f"bad primitive {schema!r}")
+        return
+    if isinstance(schema, list):  # union: pick first matching branch
+        idx = _union_index(schema, datum)
+        _write_long(buf, idx)
+        _encode(buf, schema[idx], datum)
+        return
+    t = schema["type"]
+    if t == "record":
+        for f in schema["fields"]:
+            name = f["name"]
+            if isinstance(datum, dict) and name in datum:
+                value = datum[name]
+            else:
+                value = f.get("default")
+            _encode(buf, f["type"], value)
+    elif t == "array":
+        items = list(datum or ())
+        if items:
+            _write_long(buf, len(items))
+            for it in items:
+                _encode(buf, schema["items"], it)
+        _write_long(buf, 0)
+    elif t == "map":
+        entries = dict(datum or {})
+        if entries:
+            _write_long(buf, len(entries))
+            for k, v in entries.items():
+                _encode(buf, "string", k)
+                _encode(buf, schema["values"], v)
+        _write_long(buf, 0)
+    elif t == "enum":
+        _write_long(buf, schema["symbols"].index(datum))
+    elif t == "fixed":
+        if len(datum) != schema["size"]:
+            raise ValueError("fixed size mismatch")
+        buf.write(datum)
+    else:
+        raise ValueError(f"bad schema type {t!r}")
+
+
+def _union_index(branches, datum) -> int:
+    for i, b in enumerate(branches):
+        if _matches(b, datum):
+            return i
+    raise ValueError(f"datum {datum!r} matches no union branch")
+
+
+def _matches(schema, datum) -> bool:
+    if isinstance(schema, str):
+        return {
+            "null": datum is None,
+            "boolean": isinstance(datum, bool),
+            "int": isinstance(datum, int) and not isinstance(datum, bool),
+            "long": isinstance(datum, int) and not isinstance(datum, bool),
+            "float": isinstance(datum, float),
+            "double": isinstance(datum, (float, int)) and not isinstance(datum, bool),
+            "string": isinstance(datum, str),
+            "bytes": isinstance(datum, (bytes, bytearray)),
+        }.get(schema, False)
+    if isinstance(schema, list):
+        return any(_matches(b, datum) for b in schema)
+    t = schema["type"]
+    if t == "record":
+        return isinstance(datum, dict)
+    if t == "array":
+        return isinstance(datum, (list, tuple))
+    if t == "map":
+        return isinstance(datum, dict)
+    if t == "enum":
+        return isinstance(datum, str) and datum in schema["symbols"]
+    if t == "fixed":
+        return isinstance(datum, (bytes, bytearray))
+    return False
+
+
+def _decode(buf, schema):
+    if isinstance(schema, str):
+        if schema == "null":
+            return None
+        if schema == "boolean":
+            return _read_exact(buf, 1) == b"\x01"
+        if schema in ("int", "long"):
+            return _read_long(buf)
+        if schema == "float":
+            return struct.unpack("<f", _read_exact(buf, 4))[0]
+        if schema == "double":
+            return struct.unpack("<d", _read_exact(buf, 8))[0]
+        if schema == "string":
+            n = _read_long(buf)
+            return _read_exact(buf, n).decode("utf-8")
+        if schema == "bytes":
+            n = _read_long(buf)
+            return _read_exact(buf, n)
+        raise ValueError(f"bad primitive {schema!r}")
+    if isinstance(schema, list):
+        return _decode(buf, schema[_read_long(buf)])
+    t = schema["type"]
+    if t == "record":
+        return {
+            f["name"]: _decode(buf, f["type"]) for f in schema["fields"]
+        }
+    if t == "array":
+        out = []
+        while True:
+            count = _read_long(buf)
+            if count == 0:
+                return out
+            if count < 0:
+                count = -count
+                _read_long(buf)  # block byte size, unused
+            for _ in range(count):
+                out.append(_decode(buf, schema["items"]))
+    if t == "map":
+        out = {}
+        while True:
+            count = _read_long(buf)
+            if count == 0:
+                return out
+            if count < 0:
+                count = -count
+                _read_long(buf)
+            for _ in range(count):
+                k = _decode(buf, "string")
+                out[k] = _decode(buf, schema["values"])
+    if t == "enum":
+        return schema["symbols"][_read_long(buf)]
+    if t == "fixed":
+        return _read_exact(buf, schema["size"])
+    raise ValueError(f"bad schema type {t!r}")
+
+
+# --------------------------------------------------------------------------
+# object container files
+# --------------------------------------------------------------------------
+
+_META_SCHEMA = {"type": "map", "values": "bytes"}
+
+
+def write_container(
+    path: str,
+    schema_json: dict,
+    records,
+    *,
+    codec: str = "deflate",
+    sync_interval: int = 4000,
+) -> None:
+    """Write records to an Avro object container file."""
+    schema = Schema(schema_json)
+    sync = os.urandom(SYNC_SIZE)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        meta = io.BytesIO()
+        _encode(meta, _META_SCHEMA, {
+            "avro.schema": json.dumps(schema_json).encode(),
+            "avro.codec": codec.encode(),
+        })
+        f.write(meta.getvalue())
+        f.write(sync)
+
+        block = io.BytesIO()
+        count = 0
+
+        def flush():
+            nonlocal block, count
+            if count == 0:
+                return
+            data = block.getvalue()
+            if codec == "deflate":
+                co = zlib.compressobj(wbits=-15)  # raw deflate stream
+                data = co.compress(data) + co.flush()
+            elif codec != "null":
+                raise ValueError(f"unsupported codec {codec!r}")
+            head = io.BytesIO()
+            _write_long(head, count)
+            _write_long(head, len(data))
+            f.write(head.getvalue())
+            f.write(data)
+            f.write(sync)
+            block = io.BytesIO()
+            count = 0
+
+        for rec in records:
+            _encode(block, schema.root, rec)
+            count += 1
+            if count >= sync_interval:
+                flush()
+        flush()
+
+
+def read_container(path: str) -> tuple[dict, list]:
+    """Read an Avro object container file -> (schema_json, records)."""
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        meta = _decode(f, _META_SCHEMA)
+        schema_json = json.loads(meta["avro.schema"].decode())
+        codec = meta.get("avro.codec", b"null").decode()
+        sync = f.read(SYNC_SIZE)
+        schema = Schema(schema_json)
+        records = []
+        while True:
+            try:
+                count = _read_long(f)
+            except EOFError:
+                break
+            size = _read_long(f)
+            data = f.read(size)
+            if codec == "deflate":
+                data = zlib.decompress(data, wbits=-15)
+            elif codec != "null":
+                raise ValueError(f"unsupported codec {codec!r}")
+            block = io.BytesIO(data)
+            for _ in range(count):
+                records.append(_decode(block, schema.root))
+            marker = f.read(SYNC_SIZE)
+            if marker != sync:
+                raise ValueError(f"{path}: sync marker mismatch")
+    return schema_json, records
+
+
+def read_container_dir(path: str) -> list:
+    """Read all part files of a directory of Avro containers (the HDFS
+    part-* layout of AvroUtils.readAvroFiles)."""
+    if os.path.isfile(path):
+        return read_container(path)[1]
+    records = []
+    for name in sorted(os.listdir(path)):
+        if name.endswith(".avro"):
+            records.extend(read_container(os.path.join(path, name))[1])
+    return records

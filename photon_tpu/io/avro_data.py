@@ -1,0 +1,207 @@
+"""Avro training data ingest: TrainingExampleAvro -> GameDataset.
+
+TPU-native counterpart of AvroDataReader (photon-client
+data/avro/AvroDataReader.scala:54): reads TrainingExampleAvro records (uid /
+label / features: [FeatureAvro name,term,value] / weight / offset /
+metadataMap), merges the configured feature bags into per-shard ELL feature
+matrices keyed by a feature index map (name+term joined with
+Constants.DELIMITER, AvroDataReader readMerged :85-145), and surfaces
+metadataMap entries as id tags (the GameDatum idTagToValueMap used for
+random-effect grouping and grouped evaluation, GameConverters.scala:44).
+
+Here every shard reads the record's single ``features`` array (the
+TrainingExampleAvro layout); multi-bag shard merging applies when records
+carry bag-named metadata — the reference's multi-bag Avro layouts can be
+mapped onto this via ``feature_bag_keys``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from photon_tpu.data.game_data import GameDataset, make_game_dataset
+from photon_tpu.data.dataset import SparseFeatures, rows_to_ell
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.io import avro
+from photon_tpu.types import INTERCEPT_KEY, make_feature_key
+
+import jax.numpy as jnp
+
+
+def build_index_map_from_records(
+    records, *, add_intercept: bool = True
+) -> IndexMap:
+    """Scan records for distinct (name, term) keys — the DefaultIndexMap
+    path (GameDriver.prepareFeatureMaps data-scan branch)."""
+    keys = set()
+    for rec in records:
+        for f in rec["features"]:
+            keys.add(make_feature_key(f["name"], f["term"]))
+    names = sorted(keys)
+    if add_intercept:
+        names.append(INTERCEPT_KEY)
+    return IndexMap.from_feature_names(names)
+
+
+def read_training_examples(
+    path: str,
+    *,
+    index_map: IndexMap | None = None,
+    id_tag_names: list[str] | None = None,
+    add_intercept: bool = True,
+    dtype=jnp.float32,
+) -> tuple[GameDataset, IndexMap]:
+    """Read a TrainingExampleAvro file/dir into a GameDataset.
+
+    ``id_tag_names`` picks metadataMap entries to expose as id tags; when
+    None all metadata keys found in the first record are used.
+    """
+    records = avro.read_container_dir(path)
+    if not records:
+        raise ValueError(f"no records in {path}")
+    if index_map is None:
+        index_map = build_index_map_from_records(
+            records, add_intercept=add_intercept
+        )
+    intercept = index_map.intercept_index
+
+    if id_tag_names is None:
+        # Union over ALL records: any key may be absent from the first one.
+        found: set[str] = set()
+        for rec in records:
+            found.update((rec.get("metadataMap") or {}).keys())
+        id_tag_names = sorted(found)
+
+    labels = np.empty(len(records))
+    offsets = np.zeros(len(records))
+    weights = np.ones(len(records))
+    uids = np.empty(len(records), dtype=np.int64)
+    rows = []
+    tags: dict[str, list] = {t: [] for t in id_tag_names}
+    for i, rec in enumerate(records):
+        labels[i] = rec["label"]
+        if rec.get("offset") is not None:
+            offsets[i] = rec["offset"]
+        if rec.get("weight") is not None:
+            weights[i] = rec["weight"]
+        uids[i] = _uid_to_int(rec.get("uid"), i)
+        row = []
+        for f in rec["features"]:
+            idx = index_map.get_index(make_feature_key(f["name"], f["term"]))
+            if idx is not None and f["value"] != 0.0:
+                row.append((idx, float(f["value"])))
+        if intercept is not None:
+            row.append((intercept, 1.0))
+        rows.append(row)
+        meta = rec.get("metadataMap") or {}
+        for t in id_tag_names:
+            if t not in meta:
+                # The reference fails on a missing REId (GameConverters
+                # getGameDatumFromRow); silently pooling tagless rows under
+                # one entity would train a spurious model.
+                raise ValueError(
+                    f"record {i} is missing id tag {t!r} in metadataMap"
+                )
+            tags[t].append(meta[t])
+
+    indices, values = rows_to_ell(rows, len(index_map))
+    game = make_game_dataset(
+        labels,
+        {"features": SparseFeatures(
+            jnp.asarray(indices), jnp.asarray(values, dtype=dtype),
+            len(index_map))},
+        offsets=offsets,
+        weights=weights,
+        id_tags={t: np.asarray(v) for t, v in tags.items() if v},
+        uids=uids,
+        dtype=dtype,
+    )
+    return game, index_map
+
+
+def _uid_to_int(uid, position: int) -> int:
+    """Stable int64 sample id from an Avro uid string.
+
+    The deterministic reservoir sampling keys on these
+    (build_random_effect_dataset byteswap64 hashing), so they must track the
+    record's real identity — numeric uids pass through, other strings get a
+    stable CRC-based hash, absent uids fall back to file position (the
+    reference's GameConverters hashes the row when no uid column exists).
+    """
+    if uid is None:
+        return position
+    s = str(uid)
+    try:
+        return int(s)
+    except ValueError:
+        import zlib
+
+        return (zlib.crc32(s.encode()) << 31) | (
+            zlib.crc32(s[::-1].encode())
+        )
+
+
+TRAINING_EXAMPLE_SCHEMA = {
+    "name": "TrainingExampleAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "label", "type": "double"},
+        {"name": "features", "type": {
+            "items": {
+                "name": "FeatureAvro",
+                "namespace": "com.linkedin.photon.avro.generated",
+                "type": "record",
+                "fields": [
+                    {"name": "name", "type": "string"},
+                    {"name": "term", "type": "string"},
+                    {"name": "value", "type": "double"},
+                ],
+            },
+            "type": "array",
+        }},
+        {"name": "metadataMap", "default": None,
+         "type": ["null", {"type": "map", "values": "string"}]},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+    ],
+}
+
+
+def write_training_examples(
+    path: str,
+    labels,
+    feature_rows,  # list of [(feature_key, value)] in name+term key form
+    *,
+    offsets=None,
+    weights=None,
+    metadata=None,  # list[dict[str, str]]
+    uids=None,
+) -> None:
+    """TrainingExampleAvro writer (AvroDataWriter.scala:159) — used by tests
+    and data-prep tooling to produce reference-format datasets."""
+    from photon_tpu.types import DELIMITER
+
+    labels = np.asarray(labels)
+
+    def rec(i):
+        feats = []
+        for key, val in feature_rows[i]:
+            parts = key.split(DELIMITER)
+            name, term = (parts[0], parts[1]) if len(parts) == 2 else (key, "")
+            feats.append({"name": name, "term": term, "value": float(val)})
+        return {
+            "uid": None if uids is None else str(uids[i]),
+            "label": float(labels[i]),
+            "features": feats,
+            "metadataMap": None if metadata is None else metadata[i],
+            "weight": None if weights is None else float(weights[i]),
+            "offset": None if offsets is None else float(offsets[i]),
+        }
+
+    avro.write_container(
+        path,
+        TRAINING_EXAMPLE_SCHEMA,
+        (rec(i) for i in range(labels.shape[0])),
+    )

@@ -1,0 +1,463 @@
+"""GAME model save/load in the reference's Avro directory layout.
+
+TPU-native counterpart of ModelProcessingUtils (photon-client
+data/avro/ModelProcessingUtils.scala:59): ``saveGameModelToHDFS`` (:77-130)
+writes
+
+    <dir>/model-metadata.json
+    <dir>/fixed-effect/<name>/id-info                  (one line: shard id)
+    <dir>/fixed-effect/<name>/coefficients/part-00000.avro
+    <dir>/random-effect/<name>/id-info                 (REType, shard id)
+    <dir>/random-effect/<name>/coefficients/part-*.avro
+
+with one BayesianLinearModelAvro record per GLM (per entity for random
+effects), means/variances as NameTermValueAvro lists keyed by the feature
+index map, and the model/loss class names of the reference JVM classes so
+files round-trip with the reference loader (AvroUtils.scala
+convertGLMModelToBayesianLinearModelAvro). Sparsity threshold semantics
+match saveModelToHDFS: zero coefficients are dropped on save.
+
+A fast native checkpoint (``save_checkpoint``/``load_checkpoint``) stores the
+same GameModel as one .npz + JSON manifest for warm start / resume without
+the name-keyed Avro round trip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.io import avro
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.types import DELIMITER, TaskType
+
+ID_INFO = "id-info"
+METADATA_FILE = "model-metadata.json"
+FIXED_EFFECT = "fixed-effect"
+RANDOM_EFFECT = "random-effect"
+COEFFICIENTS = "coefficients"
+DEFAULT_AVRO_FILE = "part-00000.avro"
+
+# Reference JVM class names (the loader dispatches on them,
+# ModelProcessingUtils.scala:371-391).
+_MODEL_CLASS = {
+    TaskType.LOGISTIC_REGRESSION:
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+_CLASS_TO_TASK = {v: k for k, v in _MODEL_CLASS.items()}
+_LOSS_CLASS = {
+    TaskType.LOGISTIC_REGRESSION:
+        "com.linkedin.photon.ml.function.LogisticLossFunction",
+    TaskType.LINEAR_REGRESSION:
+        "com.linkedin.photon.ml.function.SquaredLossFunction",
+    TaskType.POISSON_REGRESSION:
+        "com.linkedin.photon.ml.function.PoissonLossFunction",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        "com.linkedin.photon.ml.function.SmoothedHingeLossFunction",
+}
+
+NAME_TERM_VALUE_SCHEMA = {
+    "name": "NameTermValueAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string"},
+        {"name": "value", "type": "double"},
+    ],
+}
+BAYESIAN_LINEAR_MODEL_SCHEMA = {
+    "name": "BayesianLinearModelAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "modelId", "type": "string"},
+        {"name": "modelClass", "type": ["null", "string"], "default": None},
+        {"name": "means",
+         "type": {"items": NAME_TERM_VALUE_SCHEMA, "type": "array"}},
+        {"name": "variances", "default": None,
+         "type": ["null", {"items": "NameTermValueAvro", "type": "array"}]},
+        {"name": "lossFunction", "type": ["null", "string"], "default": None},
+    ],
+}
+SCORING_RESULT_SCHEMA = {
+    "name": "ScoringResultAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "label", "type": ["null", "double"], "default": None},
+        {"name": "modelId", "type": "string"},
+        {"name": "predictionScore", "type": "double"},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap", "default": None,
+         "type": ["null", {"type": "map", "values": "string"}]},
+    ],
+}
+
+
+def _split_key(key: str) -> tuple[str, str]:
+    parts = key.split(DELIMITER)
+    return (parts[0], parts[1]) if len(parts) == 2 else (parts[0], "")
+
+
+def _ntv_list(values: np.ndarray, indices, index_map: IndexMap,
+              sparsity_threshold: float) -> list[dict]:
+    out = []
+    for idx, v in zip(indices, values):
+        if abs(float(v)) <= sparsity_threshold:
+            continue
+        key = index_map.get_feature_name(int(idx))
+        if key is None:
+            raise KeyError(f"feature index {idx} not in index map")
+        name, term = _split_key(key)
+        out.append({"name": name, "term": term, "value": float(v)})
+    return out
+
+
+def _glm_to_record(
+    model_id: str,
+    task: TaskType,
+    means: np.ndarray,
+    variances: np.ndarray | None,
+    indices: np.ndarray,
+    index_map: IndexMap,
+    sparsity_threshold: float,
+) -> dict:
+    rec = {
+        "modelId": model_id,
+        "modelClass": _MODEL_CLASS[task],
+        "means": _ntv_list(means, indices, index_map, sparsity_threshold),
+        "variances": None,
+        "lossFunction": _LOSS_CLASS[task],
+    }
+    if variances is not None:
+        # Variances keep every entry of the saved means' support.
+        rec["variances"] = _ntv_list(
+            variances, indices, index_map, -1.0
+        )
+    return rec
+
+
+def _record_to_coefficients(
+    rec: dict, index_map: IndexMap, dim: int
+) -> tuple[Coefficients, TaskType | None]:
+    means = np.zeros(dim)
+    for ntv in rec["means"]:
+        key = f"{ntv['name']}{DELIMITER}{ntv['term']}"
+        idx = index_map.get_index(key)
+        if idx is not None:
+            means[idx] = ntv["value"]
+    variances = None
+    if rec.get("variances"):
+        variances = np.zeros(dim)
+        for ntv in rec["variances"]:
+            key = f"{ntv['name']}{DELIMITER}{ntv['term']}"
+            idx = index_map.get_index(key)
+            if idx is not None:
+                variances[idx] = ntv["value"]
+    task = _CLASS_TO_TASK.get(rec.get("modelClass") or "")
+    return Coefficients(
+        means=jnp.asarray(means),
+        variances=None if variances is None else jnp.asarray(variances),
+    ), task
+
+
+def save_game_model(
+    model: GameModel,
+    output_dir: str,
+    index_maps: dict[str, IndexMap],
+    *,
+    task: TaskType | None = None,
+    optimization_configurations: dict | None = None,
+    sparsity_threshold: float = 0.0,
+) -> None:
+    """saveGameModelToHDFS equivalent (ModelProcessingUtils.scala:77-130)."""
+    os.makedirs(output_dir, exist_ok=True)
+    task = task if task is not None else model.task
+    with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
+        json.dump({
+            "modelType": task.value,
+            "optimizationConfigurations":
+                optimization_configurations or {},
+        }, f, indent=2)
+
+    for name, sub in model.items():
+        if isinstance(sub, FixedEffectModel):
+            base = os.path.join(output_dir, FIXED_EFFECT, name)
+            os.makedirs(os.path.join(base, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(base, ID_INFO), "w") as f:
+                f.write(sub.feature_shard_id + "\n")
+            imap = index_maps[sub.feature_shard_id]
+            coefs = sub.model.coefficients
+            means = np.asarray(coefs.means)
+            rec = _glm_to_record(
+                name,
+                sub.model.task,
+                means,
+                None if coefs.variances is None else np.asarray(coefs.variances),
+                np.arange(means.shape[0]),
+                imap,
+                sparsity_threshold,
+            )
+            avro.write_container(
+                os.path.join(base, COEFFICIENTS, DEFAULT_AVRO_FILE),
+                BAYESIAN_LINEAR_MODEL_SCHEMA,
+                [rec],
+            )
+        elif isinstance(sub, RandomEffectModel):
+            base = os.path.join(output_dir, RANDOM_EFFECT, name)
+            os.makedirs(os.path.join(base, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(base, ID_INFO), "w") as f:
+                f.write(sub.random_effect_type + "\n")
+                f.write(sub.feature_shard_id + "\n")
+            imap = index_maps[sub.feature_shard_id]
+            w = np.asarray(sub.coefficients)
+            v = None if sub.variances is None else np.asarray(sub.variances)
+            records = []
+            for e in range(sub.num_entities):
+                valid = sub.proj_all[e] >= 0
+                if not valid.any():
+                    continue
+                entity_id = str(
+                    sub.entity_keys[e] if sub.entity_keys else e
+                )
+                records.append(_glm_to_record(
+                    entity_id,
+                    sub.task,
+                    w[e, valid],
+                    None if v is None else v[e, valid],
+                    sub.proj_all[e, valid],
+                    imap,
+                    sparsity_threshold,
+                ))
+            avro.write_container(
+                os.path.join(base, COEFFICIENTS, DEFAULT_AVRO_FILE),
+                BAYESIAN_LINEAR_MODEL_SCHEMA,
+                records,
+            )
+        else:
+            raise TypeError(f"unknown sub-model type for {name!r}")
+
+
+def load_game_model(
+    input_dir: str,
+    index_maps: dict[str, IndexMap],
+) -> tuple[GameModel, dict]:
+    """loadGameModelFromHDFS equivalent (ModelProcessingUtils.scala:143-240).
+
+    Returns (model, metadata). Random-effect models are reassembled into the
+    padded-matrix layout with per-entity projectors derived from each
+    entity's saved support.
+    """
+    with open(os.path.join(input_dir, METADATA_FILE)) as f:
+        metadata = json.load(f)
+    task = TaskType(metadata["modelType"])
+    models: dict[str, object] = {}
+
+    fe_dir = os.path.join(input_dir, FIXED_EFFECT)
+    if os.path.isdir(fe_dir):
+        for name in sorted(os.listdir(fe_dir)):
+            base = os.path.join(fe_dir, name)
+            with open(os.path.join(base, ID_INFO)) as f:
+                shard = f.read().strip().splitlines()[0]
+            imap = index_maps[shard]
+            records = avro.read_container_dir(
+                os.path.join(base, COEFFICIENTS)
+            )
+            if len(records) != 1:
+                raise ValueError(
+                    f"fixed-effect model {name!r}: expected 1 record, "
+                    f"got {len(records)}"
+                )
+            coefs, rec_task = _record_to_coefficients(
+                records[0], imap, len(imap)
+            )
+            models[name] = FixedEffectModel(
+                GeneralizedLinearModel(coefs, rec_task or task), shard
+            )
+
+    re_dir = os.path.join(input_dir, RANDOM_EFFECT)
+    if os.path.isdir(re_dir):
+        for name in sorted(os.listdir(re_dir)):
+            base = os.path.join(re_dir, name)
+            lines = open(os.path.join(base, ID_INFO)).read().strip().splitlines()
+            re_type, shard = lines[0], lines[1]
+            imap = index_maps[shard]
+            records = avro.read_container_dir(
+                os.path.join(base, COEFFICIENTS)
+            )
+            entity_ids = []
+            supports = []
+            means_list = []
+            var_list = []
+            any_var = False
+            for rec in records:
+                entity_ids.append(rec["modelId"])
+                idxs, ms = [], []
+                for ntv in rec["means"]:
+                    key = f"{ntv['name']}{DELIMITER}{ntv['term']}"
+                    idx = imap.get_index(key)
+                    if idx is not None:
+                        idxs.append(idx)
+                        ms.append(ntv["value"])
+                order = np.argsort(idxs, kind="stable")
+                idxs = np.asarray(idxs, dtype=np.int64)[order]
+                ms = np.asarray(ms)[order]
+                vs = None
+                if rec.get("variances"):
+                    vmap = {}
+                    for ntv in rec["variances"]:
+                        key = f"{ntv['name']}{DELIMITER}{ntv['term']}"
+                        idx = imap.get_index(key)
+                        if idx is not None:
+                            vmap[idx] = ntv["value"]
+                    vs = np.array([vmap.get(int(i), 0.0) for i in idxs])
+                    any_var = True
+                supports.append(idxs)
+                means_list.append(ms)
+                var_list.append(vs)
+            e_cnt = len(records)
+            s_max = max((s.size for s in supports), default=1)
+            s_max = max(s_max, 1)
+            w = np.zeros((e_cnt, s_max))
+            v = np.zeros((e_cnt, s_max)) if any_var else None
+            proj = np.full((e_cnt, s_max), -1, dtype=np.int64)
+            for e in range(e_cnt):
+                k = supports[e].size
+                proj[e, :k] = supports[e]
+                w[e, :k] = means_list[e]
+                if v is not None and var_list[e] is not None:
+                    v[e, :k] = var_list[e]
+            rec_task = _CLASS_TO_TASK.get(
+                (records[0].get("modelClass") or "") if records else ""
+            )
+            models[name] = RandomEffectModel(
+                coefficients=jnp.asarray(w),
+                random_effect_type=re_type,
+                feature_shard_id=shard,
+                task=rec_task or task,
+                proj_all=proj,
+                variances=None if v is None else jnp.asarray(v),
+                entity_keys=tuple(entity_ids),
+            )
+
+    if not models:
+        raise ValueError(f"no models found under {input_dir}")
+    return GameModel(models), metadata
+
+
+def save_scores(
+    path: str,
+    scores: np.ndarray,
+    *,
+    model_id: str = "",
+    uids: np.ndarray | None = None,
+    labels: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+) -> None:
+    """ScoringResultAvro writer (ScoreProcessingUtils.scala:88)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    scores = np.asarray(scores)
+
+    def rec(i):
+        return {
+            "uid": None if uids is None else str(uids[i]),
+            "label": None if labels is None else float(labels[i]),
+            "modelId": model_id,
+            "predictionScore": float(scores[i]),
+            "weight": None if weights is None else float(weights[i]),
+            "metadataMap": None,
+        }
+
+    avro.write_container(
+        path, SCORING_RESULT_SCHEMA, (rec(i) for i in range(scores.shape[0]))
+    )
+
+
+# --------------------------------------------------------------------------
+# native checkpoint (fast path; no Avro name-keying)
+# --------------------------------------------------------------------------
+
+
+def save_checkpoint(model: GameModel, path: str) -> None:
+    """One-file native GameModel checkpoint (.npz + JSON manifest)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrays: dict[str, np.ndarray] = {}
+    manifest: dict[str, dict] = {}
+    for name, sub in model.items():
+        if isinstance(sub, FixedEffectModel):
+            arrays[f"{name}/means"] = np.asarray(sub.model.coefficients.means)
+            if sub.model.coefficients.variances is not None:
+                arrays[f"{name}/variances"] = np.asarray(
+                    sub.model.coefficients.variances
+                )
+            manifest[name] = {
+                "kind": "fixed",
+                "shard": sub.feature_shard_id,
+                "task": sub.model.task.value,
+            }
+        elif isinstance(sub, RandomEffectModel):
+            arrays[f"{name}/coefficients"] = np.asarray(sub.coefficients)
+            arrays[f"{name}/proj_all"] = sub.proj_all
+            if sub.variances is not None:
+                arrays[f"{name}/variances"] = np.asarray(sub.variances)
+            manifest[name] = {
+                "kind": "random",
+                "re_type": sub.random_effect_type,
+                "shard": sub.feature_shard_id,
+                "task": sub.task.value,
+                "entity_keys": [str(k) for k in sub.entity_keys],
+            }
+        else:
+            raise TypeError(f"unknown sub-model type for {name!r}")
+    arrays["__manifest__"] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(path, **arrays)
+
+
+def load_checkpoint(path: str) -> GameModel:
+    with np.load(path) as z:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+        models: dict[str, object] = {}
+        for name, info in manifest.items():
+            task = TaskType(info["task"])
+            if info["kind"] == "fixed":
+                var_key = f"{name}/variances"
+                coefs = Coefficients(
+                    means=jnp.asarray(z[f"{name}/means"]),
+                    variances=(jnp.asarray(z[var_key])
+                               if var_key in z else None),
+                )
+                models[name] = FixedEffectModel(
+                    GeneralizedLinearModel(coefs, task), info["shard"]
+                )
+            else:
+                var_key = f"{name}/variances"
+                models[name] = RandomEffectModel(
+                    coefficients=jnp.asarray(z[f"{name}/coefficients"]),
+                    random_effect_type=info["re_type"],
+                    feature_shard_id=info["shard"],
+                    task=task,
+                    proj_all=z[f"{name}/proj_all"],
+                    variances=(jnp.asarray(z[var_key])
+                               if var_key in z else None),
+                    entity_keys=tuple(info["entity_keys"]),
+                )
+    return GameModel(models)

@@ -24,8 +24,13 @@ FeatureShardId = str
 # delimiter into a flat string key (photon-client Constants).
 FeatureKey = str
 
-INTERCEPT_KEY: FeatureKey = "(INTERCEPT)"
 DELIMITER = "\x01"
+INTERCEPT_NAME = "(INTERCEPT)"
+INTERCEPT_TERM = ""
+# Reference parity: Constants.INTERCEPT_KEY = getFeatureKey(name, term), i.e.
+# the delimiter-joined (name, term) pair — "(INTERCEPT)\x01"
+# (photon-client Constants.scala:40-42).
+INTERCEPT_KEY: FeatureKey = f"{INTERCEPT_NAME}{DELIMITER}{INTERCEPT_TERM}"
 
 
 class TaskType(enum.Enum):

@@ -1,0 +1,250 @@
+"""I/O: Avro codec, GAME model save/load, checkpoints, data round trips.
+
+Mirrors the reference's ModelProcessingUtilsTest (save/load round trip with
+feature-index re-mapping) and AvroDataReader tests.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.io import avro
+from photon_tpu.io.avro_data import (
+    read_training_examples,
+    write_training_examples,
+)
+from photon_tpu.io.model_io import (
+    BAYESIAN_LINEAR_MODEL_SCHEMA,
+    load_checkpoint,
+    load_game_model,
+    save_checkpoint,
+    save_game_model,
+    save_scores,
+)
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.types import DELIMITER, TaskType
+
+
+class TestAvroCodec:
+    def test_primitive_round_trip(self, tmp_path):
+        schema = {
+            "name": "T", "type": "record",
+            "fields": [
+                {"name": "s", "type": "string"},
+                {"name": "d", "type": "double"},
+                {"name": "l", "type": "long"},
+                {"name": "b", "type": "boolean"},
+                {"name": "u", "type": ["null", "string"], "default": None},
+                {"name": "a", "type": {"type": "array", "items": "double"}},
+                {"name": "m", "type": {"type": "map", "values": "string"}},
+            ],
+        }
+        recs = [
+            {"s": "héllo", "d": -1.5, "l": 2**40, "b": True, "u": None,
+             "a": [1.0, 2.5], "m": {"k": "v"}},
+            {"s": "", "d": 0.0, "l": -7, "b": False, "u": "x",
+             "a": [], "m": {}},
+        ]
+        p = str(tmp_path / "t.avro")
+        avro.write_container(p, schema, recs)
+        schema_out, got = avro.read_container(p)
+        assert got == recs
+        assert schema_out["name"] == "T"
+
+    def test_null_codec_and_blocks(self, tmp_path):
+        schema = {"name": "R", "type": "record",
+                  "fields": [{"name": "x", "type": "long"}]}
+        recs = [{"x": i} for i in range(10000)]
+        p = str(tmp_path / "r.avro")
+        avro.write_container(p, schema, recs, codec="null",
+                             sync_interval=1000)
+        _, got = avro.read_container(p)
+        assert got == recs
+
+    def test_corrupt_magic_raises(self, tmp_path):
+        p = tmp_path / "bad.avro"
+        p.write_bytes(b"nope")
+        with pytest.raises(ValueError, match="not an Avro"):
+            avro.read_container(str(p))
+
+
+def _index_map(d):
+    from photon_tpu.types import INTERCEPT_KEY
+
+    names = [f"f{i}{DELIMITER}t" for i in range(d - 1)] + [INTERCEPT_KEY]
+    return IndexMap.from_feature_names(names)
+
+
+def _game_model(rng, d=6, e=4, s=3):
+    fixed = FixedEffectModel(
+        GeneralizedLinearModel(
+            Coefficients(
+                means=jnp.asarray(rng.normal(size=d)),
+                variances=jnp.asarray(rng.uniform(0.1, 1.0, size=d)),
+            ),
+            TaskType.LOGISTIC_REGRESSION,
+        ),
+        "shardA",
+    )
+    proj = np.full((e, s), -1, dtype=np.int64)
+    for i in range(e):
+        proj[i, : 2 + i % 2] = np.sort(
+            rng.choice(d, size=2 + i % 2, replace=False)
+        )
+    w = rng.normal(size=(e, s))
+    w[proj < 0] = 0.0
+    random = RandomEffectModel(
+        coefficients=jnp.asarray(w),
+        random_effect_type="userId",
+        feature_shard_id="shardB",
+        task=TaskType.LOGISTIC_REGRESSION,
+        proj_all=proj,
+        entity_keys=tuple(f"u{i}" for i in range(e)),
+    )
+    return GameModel({"global": fixed, "per-user": random})
+
+
+class TestGameModelIO:
+    def test_save_load_round_trip(self, rng, tmp_path):
+        model = _game_model(rng)
+        imaps = {"shardA": _index_map(6), "shardB": _index_map(6)}
+        out = str(tmp_path / "model")
+        save_game_model(
+            model, out, imaps,
+            optimization_configurations={"global": {"lambda": 1.0}},
+        )
+        # Reference directory layout.
+        assert os.path.isfile(
+            os.path.join(out, "fixed-effect", "global", "id-info"))
+        assert os.path.isfile(os.path.join(
+            out, "fixed-effect", "global", "coefficients",
+            "part-00000.avro"))
+        assert os.path.isfile(
+            os.path.join(out, "random-effect", "per-user", "id-info"))
+
+        loaded, meta = load_game_model(out, imaps)
+        assert meta["modelType"] == "LOGISTIC_REGRESSION"
+        np.testing.assert_allclose(
+            np.asarray(loaded["global"].model.coefficients.means),
+            np.asarray(model["global"].model.coefficients.means),
+        )
+        np.testing.assert_allclose(
+            np.asarray(loaded["global"].model.coefficients.variances),
+            np.asarray(model["global"].model.coefficients.variances),
+        )
+        # Random-effect coefficients by (entity, feature id).
+        orig, got = model["per-user"], loaded["per-user"]
+        assert got.random_effect_type == "userId"
+        vocab = {k: i for i, k in enumerate(got.entity_keys)}
+        for e, key in enumerate(orig.entity_keys):
+            for s_, f in enumerate(orig.proj_all[e]):
+                if f < 0 or abs(float(orig.coefficients[e, s_])) == 0.0:
+                    continue
+                eg = vocab[key]
+                slot = np.nonzero(got.proj_all[eg] == f)[0]
+                assert slot.size == 1
+                np.testing.assert_allclose(
+                    float(got.coefficients[eg, slot[0]]),
+                    float(orig.coefficients[e, s_]),
+                )
+
+    def test_loaded_model_scores_identically(self, rng, tmp_path):
+        """Save -> load -> score must reproduce the original scores (the
+        ModelProcessingUtilsTest parity property)."""
+        from photon_tpu.data.dataset import DenseFeatures
+        from photon_tpu.data.game_data import make_game_dataset
+        from photon_tpu.transformers import GameTransformer
+
+        model = _game_model(rng)
+        imaps = {"shardA": _index_map(6), "shardB": _index_map(6)}
+        out = str(tmp_path / "model")
+        save_game_model(model, out, imaps)
+        loaded, _ = load_game_model(out, imaps)
+
+        n = 40
+        x = rng.normal(size=(n, 6))
+        data = make_game_dataset(
+            np.zeros(n),
+            {"shardA": DenseFeatures(jnp.asarray(x)),
+             "shardB": DenseFeatures(jnp.asarray(x))},
+            id_tags={"userId": np.asarray(
+                [f"u{i % 4}" for i in range(n)])},
+            dtype=jnp.float64,
+        )
+        s0 = np.asarray(GameTransformer(model).score(data))
+        s1 = np.asarray(GameTransformer(loaded).score(data))
+        np.testing.assert_allclose(s1, s0, rtol=1e-12)
+
+    def test_sparsity_threshold_drops_zeros(self, rng, tmp_path):
+        model = _game_model(rng)
+        imaps = {"shardA": _index_map(6), "shardB": _index_map(6)}
+        out = str(tmp_path / "model")
+        save_game_model(model, out, imaps, sparsity_threshold=1e10)
+        recs = avro.read_container_dir(os.path.join(
+            out, "fixed-effect", "global", "coefficients"))
+        assert recs[0]["means"] == []
+
+    def test_checkpoint_round_trip(self, rng, tmp_path):
+        model = _game_model(rng)
+        p = str(tmp_path / "ckpt.npz")
+        save_checkpoint(model, p)
+        loaded = load_checkpoint(p)
+        np.testing.assert_allclose(
+            np.asarray(loaded["per-user"].coefficients),
+            np.asarray(model["per-user"].coefficients),
+        )
+        assert loaded["per-user"].entity_keys == model["per-user"].entity_keys
+        assert loaded["global"].model.task == TaskType.LOGISTIC_REGRESSION
+
+
+class TestTrainingDataIO:
+    def test_write_read_round_trip(self, rng, tmp_path):
+        n, d = 30, 4
+        keys = [f"f{i}{DELIMITER}t" for i in range(d)]
+        rows = []
+        for i in range(n):
+            nz = rng.choice(d, size=2, replace=False)
+            rows.append([(keys[j], float(rng.normal())) for j in nz])
+        labels = rng.normal(size=n)
+        weights = rng.uniform(0.5, 2.0, size=n)
+        offsets = rng.normal(size=n) * 0.1
+        meta = [{"userId": f"u{i % 3}"} for i in range(n)]
+        p = str(tmp_path / "train.avro")
+        write_training_examples(
+            p, labels, rows, offsets=offsets, weights=weights,
+            metadata=meta, uids=np.arange(n),
+        )
+        game, imap = read_training_examples(p)
+        assert game.num_samples == n
+        assert imap.has_intercept
+        np.testing.assert_allclose(np.asarray(game.labels), labels,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(game.weights), weights,
+                                   rtol=1e-6)
+        assert game.id_tags["userId"].num_groups == 3
+        # Feature values land at the index-mapped columns (+ intercept 1).
+        feats = game.feature_shards["features"]
+        row0 = {int(i): float(v) for i, v in
+                zip(np.asarray(feats.indices[0]),
+                    np.asarray(feats.values[0])) if v != 0.0}
+        want = {imap.get_index(k): pytest.approx(v, rel=1e-6)
+                for k, v in rows[0]}
+        want[imap.intercept_index] = 1.0
+        assert row0 == want
+
+    def test_scores_writer(self, tmp_path, rng):
+        p = str(tmp_path / "scores.avro")
+        save_scores(p, rng.normal(size=10), model_id="m",
+                    uids=np.arange(10))
+        recs = avro.read_container(p)[1]
+        assert len(recs) == 10
+        assert recs[0]["modelId"] == "m"
+        assert recs[3]["uid"] == "3"
