@@ -1,0 +1,197 @@
+"""Training/scoring configuration: YAML/JSON -> typed configs.
+
+TPU-native counterpart of the reference's three-tier config system (SURVEY
+§5.6): scopt CLI flags -> Spark-ML ParamMap -> typed case classes
+(io/scopt/ScoptGameTrainingParametersParser.scala:42,
+io/CoordinateConfiguration.scala:25-70). The nested ``name=...|...`` scopt
+map syntax becomes one YAML/JSON document with the same vocabulary:
+optimizer type/tolerance/iterations, regularization type/alpha/weights
+(the per-coordinate lambda grid), active data bounds, down-sampling,
+update sequence, normalization, evaluators, output modes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+
+from photon_tpu import optim
+from photon_tpu.algorithm.problems import (
+    GLMOptimizationConfiguration,
+    VarianceComputationType,
+)
+from photon_tpu.data.random_effect import RandomEffectDataConfiguration
+from photon_tpu.estimators.game_estimator import (
+    FixedEffectCoordinateConfiguration,
+    GameEstimator,
+    RandomEffectCoordinateConfiguration,
+)
+from photon_tpu.ops.normalization import NormalizationType
+from photon_tpu.types import TaskType
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateSpec:
+    """One coordinate's parsed config + its lambda grid.
+
+    Reference: io/CoordinateConfiguration.scala:25-70 — data config + opt
+    config + regularization weight set, expanded per lambda sorted
+    descending (:62).
+    """
+
+    config: object  # FixedEffect/RandomEffectCoordinateConfiguration
+    lambdas: tuple[float, ...]
+
+    def expanded(self) -> list[GLMOptimizationConfiguration]:
+        base = self.config.optimization
+        if not self.lambdas:
+            return [base]
+        return [
+            base.with_regularization_weight(lam)
+            for lam in sorted(self.lambdas, reverse=True)
+        ]
+
+
+def _parse_optimizer(d: dict) -> optim.OptimizerConfig:
+    kind = optim.OptimizerType(d.get("type", "LBFGS").upper())
+    kw = {}
+    for key in ("tolerance", "max_iterations", "num_corrections",
+                "max_improvement_failures", "max_cg_iterations",
+                "max_line_search_iterations"):
+        if key in d:
+            kw[key] = d[key]
+    if kind == optim.OptimizerType.TRON:
+        return optim.OptimizerConfig.tron(**kw)
+    return optim.OptimizerConfig.lbfgs(**kw)
+
+
+def _parse_regularization(d: dict) -> tuple[optim.RegularizationContext, tuple[float, ...]]:
+    kind = optim.RegularizationType(d.get("type", "NONE").upper())
+    ctx = optim.RegularizationContext(
+        kind,
+        alpha=d.get("alpha") if kind == optim.RegularizationType.ELASTIC_NET
+        else None,
+    )
+    weights = d.get("weights", d.get("weight", ()))
+    if isinstance(weights, (int, float)):
+        weights = (float(weights),)
+    return ctx, tuple(float(w) for w in weights)
+
+
+def parse_coordinate(cid: str, d: dict) -> CoordinateSpec:
+    opt_cfg = GLMOptimizationConfiguration(
+        optimizer=_parse_optimizer(d.get("optimizer", {})),
+        down_sampling_rate=float(d.get("down_sampling_rate", 1.0)),
+        variance_computation=VarianceComputationType(
+            d.get("variance_computation", "NONE").upper()
+        ),
+    )
+    reg, lambdas = _parse_regularization(d.get("regularization", {}))
+    opt_cfg = dataclasses.replace(
+        opt_cfg,
+        regularization=reg,
+        regularization_weight=lambdas[0] if lambdas else 0.0,
+    )
+    shard = d.get("feature_shard", "features")
+    kind = d.get("type", "fixed").lower()
+    if kind in ("fixed", "fixed_effect", "fixed-effect"):
+        cfg = FixedEffectCoordinateConfiguration(shard, opt_cfg)
+    elif kind in ("random", "random_effect", "random-effect"):
+        cfg = RandomEffectCoordinateConfiguration(
+            RandomEffectDataConfiguration(
+                random_effect_type=d["random_effect_type"],
+                feature_shard_id=shard,
+                active_data_upper_bound=d.get("active_data_upper_bound"),
+                active_data_lower_bound=d.get("active_data_lower_bound"),
+                features_to_samples_ratio=d.get("features_to_samples_ratio"),
+            ),
+            opt_cfg,
+        )
+    else:
+        raise ValueError(f"coordinate {cid!r}: unknown type {kind!r}")
+    return CoordinateSpec(cfg, lambdas)
+
+
+@dataclasses.dataclass
+class TrainingConfig:
+    """Parsed `photon train` configuration (GameTrainingDriver params)."""
+
+    task: TaskType
+    coordinates: dict[str, CoordinateSpec]
+    update_sequence: list[str]
+    num_iterations: int
+    input_format: str  # "avro" | "libsvm"
+    train_path: str
+    validation_path: str | None
+    output_dir: str
+    id_tags: list[str] | None
+    normalization: NormalizationType
+    evaluators: list[str]
+    model_output_mode: str  # ALL | BEST
+    warm_start_model_dir: str | None
+    locked_coordinates: set[str]
+    hyperparameter_tuning: dict | None
+
+    @staticmethod
+    def load(path: str) -> "TrainingConfig":
+        raw = _read_config_file(path)
+        coords = {
+            cid: parse_coordinate(cid, c)
+            for cid, c in raw["coordinates"].items()
+        }
+        return TrainingConfig(
+            task=TaskType(raw["task"].upper()),
+            coordinates=coords,
+            update_sequence=list(
+                raw.get("update_sequence", list(coords))
+            ),
+            num_iterations=int(raw.get("num_iterations", 1)),
+            input_format=raw.get("input", {}).get("format", "avro"),
+            train_path=raw["input"]["train_path"],
+            validation_path=raw.get("input", {}).get("validation_path"),
+            output_dir=raw["output_dir"],
+            id_tags=raw.get("input", {}).get("id_tags"),
+            normalization=NormalizationType(
+                raw.get("normalization", "NONE").upper()
+            ),
+            evaluators=list(raw.get("evaluators", [])),
+            model_output_mode=raw.get("model_output_mode", "BEST").upper(),
+            warm_start_model_dir=raw.get("warm_start_model_dir"),
+            locked_coordinates=set(raw.get("locked_coordinates", ())),
+            hyperparameter_tuning=raw.get("hyperparameter_tuning"),
+        )
+
+    def opt_config_sequence(self) -> list[dict[str, GLMOptimizationConfiguration]]:
+        """Cartesian product of per-coordinate lambda grids, each entry one
+        full GAME optimization configuration
+        (GameTrainingDriver.prepareGameOptConfigs :658-667)."""
+        ids = list(self.coordinates)
+        grids = [self.coordinates[cid].expanded() for cid in ids]
+        return [
+            dict(zip(ids, combo)) for combo in itertools.product(*grids)
+        ]
+
+    def build_estimator(
+        self, normalization_contexts=None, intercept_indices=None
+    ) -> GameEstimator:
+        return GameEstimator(
+            self.task,
+            {cid: spec.config for cid, spec in self.coordinates.items()},
+            update_sequence=self.update_sequence,
+            num_iterations=self.num_iterations,
+            normalization=normalization_contexts or {},
+            intercept_indices=intercept_indices or {},
+            evaluators=self.evaluators or None,
+            locked_coordinates=self.locked_coordinates,
+        )
+
+
+def _read_config_file(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        return json.loads(text)
+    import yaml
+
+    return yaml.safe_load(text)

@@ -1,0 +1,123 @@
+"""``photon score``: batch scoring driver.
+
+TPU-native counterpart of GameScoringDriver (photon-client
+cli/game/scoring/GameScoringDriver.scala:39, run :136-197): feature maps ->
+read data -> load GAME model -> GameTransformer -> save ScoringResultAvro
+(+ optional evaluation).
+
+Usage:
+    python -m photon_tpu.cli.score --model-dir out/models/best \
+        --input data.avro --output scores/ [--evaluators AUC RMSE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon score", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--model-dir", required=True,
+                        help="GAME model directory (Avro layout)")
+    parser.add_argument("--input", required=True,
+                        help="TrainingExampleAvro data file/dir")
+    parser.add_argument("--output", required=True,
+                        help="output directory for scores")
+    parser.add_argument("--model-id", default="")
+    parser.add_argument("--evaluators", nargs="*", default=None,
+                        help="optional metrics, e.g. AUC RMSE AUC:userId")
+    parser.add_argument("--id-tags", nargs="*", default=None)
+    parser.add_argument("--backend", default=None)
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.backend:
+        os.environ["JAX_PLATFORMS"] = args.backend
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING)
+
+    import numpy as np
+
+    from photon_tpu.io.avro_data import (
+        build_index_map_from_records,
+        read_training_examples,
+    )
+    from photon_tpu.io import avro
+    from photon_tpu.io.model_io import load_game_model, save_scores
+    from photon_tpu.transformers import GameTransformer
+
+    # Feature index from the scoring data's own keys PLUS the model's: the
+    # reference resolves keys through the same feature maps used at training
+    # time; here the model files name features explicitly, so the union map
+    # reproduces the training indices for every known feature.
+    records = avro.read_container_dir(args.input)
+    index_map = build_index_map_from_records(records)
+    data, _ = read_training_examples(
+        args.input, index_map=index_map, id_tag_names=args.id_tags
+    )
+    # Every shard named by the model resolves against the data's single
+    # feature table.
+    needed_shards = set()
+    import os.path as osp
+    for kind in ("fixed-effect", "random-effect"):
+        d = osp.join(args.model_dir, kind)
+        if osp.isdir(d):
+            for name in os.listdir(d):
+                with open(osp.join(d, name, "id-info")) as f:
+                    needed_shards.add(f.read().strip().splitlines()[-1])
+    index_maps = {s: index_map for s in needed_shards} or {
+        "features": index_map}
+    model, metadata = load_game_model(args.model_dir, index_maps)
+
+    data = _alias_shards(data, needed_shards)
+    transformer = GameTransformer(model)
+    scores, evaluation = transformer.transform(
+        data, evaluators=args.evaluators
+    )
+
+    os.makedirs(args.output, exist_ok=True)
+    save_scores(
+        os.path.join(args.output, "part-00000.avro"),
+        np.asarray(scores),
+        model_id=args.model_id or metadata.get("modelType", ""),
+        uids=None if data.uids is None else data.uids,
+        labels=np.asarray(data.labels),
+        weights=np.asarray(data.weights),
+    )
+    out = {
+        "num_scored": int(np.asarray(scores).shape[0]),
+        "output": args.output,
+    }
+    if evaluation is not None:
+        out["evaluation"] = evaluation.evaluations
+        with open(os.path.join(args.output, "evaluation.json"), "w") as f:
+            json.dump(evaluation.evaluations, f, indent=2)
+    print(json.dumps(out))
+    return 0
+
+
+def _alias_shards(data, shard_names):
+    """Expose the single ingest feature table under every model shard name."""
+    import dataclasses
+
+    missing = {
+        s for s in shard_names if s not in data.feature_shards
+    }
+    if not missing:
+        return data
+    table = data.feature_shards["features"]
+    shards = dict(data.feature_shards)
+    for s in missing:
+        shards[s] = table
+    return dataclasses.replace(data, feature_shards=shards)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,223 @@
+"""``photon train``: end-to-end GAME training driver.
+
+TPU-native counterpart of GameTrainingDriver (photon-client
+cli/game/training/GameTrainingDriver.scala:54, run :363-516): read data ->
+feature index map -> warm-start model load -> feature stats -> normalization
+contexts -> coordinate configs x lambda grid -> GameEstimator.fit ->
+model selection -> save models (Avro layout + native checkpoint + eval
+summary).
+
+Usage:
+    python -m photon_tpu.cli.train --config train.yaml [--backend tpu|cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon train", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--config", required=True,
+                        help="YAML/JSON training configuration")
+    parser.add_argument("--backend", default=None,
+                        help="JAX platform override (tpu, cpu, axon, ...)")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.backend:
+        os.environ["JAX_PLATFORMS"] = args.backend
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    log = logging.getLogger("photon.train")
+
+    # Imports follow the backend env override.
+    from photon_tpu.cli.config import TrainingConfig
+    from photon_tpu.data.libsvm import read_libsvm
+    from photon_tpu.data.index_map import IndexMap
+    from photon_tpu.io.avro_data import read_training_examples
+    from photon_tpu.io.model_io import (
+        load_game_model,
+        save_checkpoint,
+        save_game_model,
+    )
+    from photon_tpu.ops.normalization import (
+        NormalizationType,
+        build_normalization_context,
+    )
+    from photon_tpu.stat import FeatureDataStatistics
+
+    t_start = time.time()
+    cfg = TrainingConfig.load(args.config)
+    os.makedirs(cfg.output_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    # read data (readTrainingData :537)
+    # ------------------------------------------------------------------
+    def read_libsvm_game(path, index_map=None):
+        """libsvm -> single-shard GameDataset + identity index map."""
+        from photon_tpu.data.game_data import make_game_dataset
+
+        if index_map is None:
+            batch = read_libsvm(path)
+            imap = IndexMap.identity(
+                batch.num_features - 1, add_intercept=True
+            )
+        else:
+            imap = index_map
+            batch = read_libsvm(path, num_features=len(imap) - 1)
+        game = make_game_dataset(
+            batch.labels,
+            {"features": batch.features},
+            offsets=batch.offsets,
+            weights=batch.weights,
+        )
+        return game, imap
+
+    if cfg.input_format == "avro":
+        train, index_map = read_training_examples(
+            cfg.train_path, id_tag_names=cfg.id_tags
+        )
+        validation = None
+        if cfg.validation_path:
+            validation, _ = read_training_examples(
+                cfg.validation_path,
+                index_map=index_map,
+                id_tag_names=cfg.id_tags,
+            )
+    elif cfg.input_format == "libsvm":
+        train, index_map = read_libsvm_game(cfg.train_path)
+        validation = None
+        if cfg.validation_path:
+            validation, _ = read_libsvm_game(
+                cfg.validation_path, index_map=index_map
+            )
+    else:
+        raise ValueError(f"unknown input format {cfg.input_format!r}")
+    log.info("read %d train rows (%d features)",
+             train.num_samples, len(index_map))
+
+    shards = sorted(train.feature_shards)
+    index_maps = {s: index_map for s in shards}
+    intercept_indices = {}
+    if index_map.intercept_index is not None:
+        intercept_indices = {
+            s: index_map.intercept_index for s in shards
+        }
+
+    # ------------------------------------------------------------------
+    # warm start (loadGameModelFromHDFS :395-404)
+    # ------------------------------------------------------------------
+    initial_model = None
+    if cfg.warm_start_model_dir:
+        initial_model, _ = load_game_model(
+            cfg.warm_start_model_dir, index_maps
+        )
+        log.info("warm start from %s", cfg.warm_start_model_dir)
+
+    # ------------------------------------------------------------------
+    # feature stats + normalization (prepareNormalizationContexts :590)
+    # ------------------------------------------------------------------
+    norm_contexts = {}
+    if cfg.normalization != NormalizationType.NONE:
+        import numpy as np
+
+        for s in shards:
+            stats = FeatureDataStatistics.from_features(
+                train.feature_shards[s],
+                np.asarray(train.weights),
+                intercept_index=intercept_indices.get(s),
+            )
+            import jax.numpy as jnp
+
+            norm_contexts[s] = build_normalization_context(
+                cfg.normalization,
+                mean=jnp.asarray(stats.mean),
+                variance=jnp.asarray(stats.variance),
+                min_=jnp.asarray(stats.min),
+                max_=jnp.asarray(stats.max),
+                intercept_index=intercept_indices.get(s),
+            )
+
+    # ------------------------------------------------------------------
+    # fit over the lambda grid (GameEstimator.fit :397)
+    # ------------------------------------------------------------------
+    estimator = cfg.build_estimator(norm_contexts, intercept_indices)
+    opt_seq = cfg.opt_config_sequence()
+    log.info("training %d configuration(s)", len(opt_seq))
+    results = estimator.fit(
+        train, validation, opt_seq, initial_model=initial_model
+    )
+
+    # ------------------------------------------------------------------
+    # model selection + save (selectBestModel :753, saveModelToHDFS :804)
+    # ------------------------------------------------------------------
+    best = estimator.select_best(results)
+    best_idx = next(i for i, r in enumerate(results) if r is best)
+
+    def config_json(r):
+        return {
+            cid: {
+                "regularization":
+                    c.regularization.regularization_type.value,
+                "lambda": c.regularization_weight,
+                "optimizer": c.optimizer.optimizer_type.value,
+            }
+            for cid, c in r.config.items()
+        }
+
+    summary = {
+        "task": cfg.task.value,
+        "num_configurations": len(results),
+        "best_configuration_index": best_idx,
+        "configurations": [
+            {
+                "config": config_json(r),
+                "evaluation":
+                    None if r.evaluation is None else r.evaluation.evaluations,
+            }
+            for r in results
+        ],
+        "wall_clock_seconds": round(time.time() - t_start, 2),
+    }
+    with open(os.path.join(cfg.output_dir, "training-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+
+    to_save = (
+        [(best_idx, best)] if cfg.model_output_mode == "BEST"
+        else list(enumerate(results))
+    )
+    for i, r in to_save:
+        subdir = ("best" if cfg.model_output_mode == "BEST"
+                  and i == best_idx else f"config_{i}")
+        out = os.path.join(cfg.output_dir, "models", subdir)
+        save_game_model(
+            r.model, out, index_maps,
+            task=cfg.task,
+            optimization_configurations=config_json(r),
+        )
+        save_checkpoint(r.model, os.path.join(out, "checkpoint.npz"))
+    log.info("saved %d model(s) to %s", len(to_save),
+             os.path.join(cfg.output_dir, "models"))
+    print(json.dumps({
+        "best_configuration": config_json(best),
+        "evaluation":
+            None if best.evaluation is None else best.evaluation.evaluations,
+        "output_dir": cfg.output_dir,
+        "wall_clock_seconds": summary["wall_clock_seconds"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,7 @@ from photon_tpu.data.game_data import GameDataset, make_game_dataset
 from photon_tpu.data.dataset import SparseFeatures, rows_to_ell
 from photon_tpu.data.index_map import IndexMap
 from photon_tpu.io import avro
-from photon_tpu.types import INTERCEPT_KEY, make_feature_key
+from photon_tpu.types import make_feature_key, split_feature_key
 
 import jax.numpy as jnp
 
@@ -37,10 +37,7 @@ def build_index_map_from_records(
     for rec in records:
         for f in rec["features"]:
             keys.add(make_feature_key(f["name"], f["term"]))
-    names = sorted(keys)
-    if add_intercept:
-        names.append(INTERCEPT_KEY)
-    return IndexMap.from_feature_names(names)
+    return IndexMap.from_feature_names(keys, add_intercept=add_intercept)
 
 
 def read_training_examples(
@@ -181,15 +178,12 @@ def write_training_examples(
 ) -> None:
     """TrainingExampleAvro writer (AvroDataWriter.scala:159) — used by tests
     and data-prep tooling to produce reference-format datasets."""
-    from photon_tpu.types import DELIMITER
-
     labels = np.asarray(labels)
 
     def rec(i):
         feats = []
         for key, val in feature_rows[i]:
-            parts = key.split(DELIMITER)
-            name, term = (parts[0], parts[1]) if len(parts) == 2 else (key, "")
+            name, term = split_feature_key(key)
             feats.append({"name": name, "term": term, "value": float(val)})
         return {
             "uid": None if uids is None else str(uids[i]),

@@ -36,9 +36,10 @@ from photon_tpu.models.game import (
     FixedEffectModel,
     GameModel,
     RandomEffectModel,
+    random_effect_model_to_glms,
 )
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
-from photon_tpu.types import DELIMITER, TaskType
+from photon_tpu.types import TaskType, make_feature_key, split_feature_key
 
 ID_INFO = "id-info"
 METADATA_FILE = "model-metadata.json"
@@ -111,11 +112,6 @@ SCORING_RESULT_SCHEMA = {
 }
 
 
-def _split_key(key: str) -> tuple[str, str]:
-    parts = key.split(DELIMITER)
-    return (parts[0], parts[1]) if len(parts) == 2 else (parts[0], "")
-
-
 def _ntv_list(values: np.ndarray, indices, index_map: IndexMap,
               sparsity_threshold: float) -> list[dict]:
     out = []
@@ -125,7 +121,7 @@ def _ntv_list(values: np.ndarray, indices, index_map: IndexMap,
         key = index_map.get_feature_name(int(idx))
         if key is None:
             raise KeyError(f"feature index {idx} not in index map")
-        name, term = _split_key(key)
+        name, term = split_feature_key(key)
         out.append({"name": name, "term": term, "value": float(v)})
     return out
 
@@ -147,7 +143,8 @@ def _glm_to_record(
         "lossFunction": _LOSS_CLASS[task],
     }
     if variances is not None:
-        # Variances keep every entry of the saved means' support.
+        # Variances keep the full support (threshold -1), including
+        # coefficients whose mean is exactly zero (L1 solutions).
         rec["variances"] = _ntv_list(
             variances, indices, index_map, -1.0
         )
@@ -159,16 +156,16 @@ def _record_to_coefficients(
 ) -> tuple[Coefficients, TaskType | None]:
     means = np.zeros(dim)
     for ntv in rec["means"]:
-        key = f"{ntv['name']}{DELIMITER}{ntv['term']}"
-        idx = index_map.get_index(key)
+        idx = index_map.get_index(make_feature_key(ntv["name"], ntv["term"]))
         if idx is not None:
             means[idx] = ntv["value"]
     variances = None
     if rec.get("variances"):
         variances = np.zeros(dim)
         for ntv in rec["variances"]:
-            key = f"{ntv['name']}{DELIMITER}{ntv['term']}"
-            idx = index_map.get_index(key)
+            idx = index_map.get_index(
+                make_feature_key(ntv["name"], ntv["term"])
+            )
             if idx is not None:
                 variances[idx] = ntv["value"]
     task = _CLASS_TO_TASK.get(rec.get("modelClass") or "")
@@ -227,25 +224,19 @@ def save_game_model(
                 f.write(sub.random_effect_type + "\n")
                 f.write(sub.feature_shard_id + "\n")
             imap = index_maps[sub.feature_shard_id]
-            w = np.asarray(sub.coefficients)
-            v = None if sub.variances is None else np.asarray(sub.variances)
-            records = []
-            for e in range(sub.num_entities):
-                valid = sub.proj_all[e] >= 0
-                if not valid.any():
-                    continue
-                entity_id = str(
-                    sub.entity_keys[e] if sub.entity_keys else e
-                )
-                records.append(_glm_to_record(
+            records = [
+                _glm_to_record(
                     entity_id,
                     sub.task,
-                    w[e, valid],
-                    None if v is None else v[e, valid],
-                    sub.proj_all[e, valid],
+                    coefs.means,
+                    coefs.variances,
+                    coefs.feature_indices,
                     imap,
                     sparsity_threshold,
-                ))
+                )
+                for entity_id, coefs in
+                random_effect_model_to_glms(sub).items()
+            ]
             avro.write_container(
                 os.path.join(base, COEFFICIENTS, DEFAULT_AVRO_FILE),
                 BAYESIAN_LINEAR_MODEL_SCHEMA,
@@ -309,29 +300,36 @@ def load_game_model(
             any_var = False
             for rec in records:
                 entity_ids.append(rec["modelId"])
-                idxs, ms = [], []
+                mmap: dict[int, float] = {}
                 for ntv in rec["means"]:
-                    key = f"{ntv['name']}{DELIMITER}{ntv['term']}"
-                    idx = imap.get_index(key)
+                    idx = imap.get_index(
+                        make_feature_key(ntv["name"], ntv["term"])
+                    )
                     if idx is not None:
-                        idxs.append(idx)
-                        ms.append(ntv["value"])
-                order = np.argsort(idxs, kind="stable")
-                idxs = np.asarray(idxs, dtype=np.int64)[order]
-                ms = np.asarray(ms)[order]
-                vs = None
+                        mmap[idx] = ntv["value"]
+                vmap: dict[int, float] = {}
                 if rec.get("variances"):
-                    vmap = {}
                     for ntv in rec["variances"]:
-                        key = f"{ntv['name']}{DELIMITER}{ntv['term']}"
-                        idx = imap.get_index(key)
+                        idx = imap.get_index(
+                            make_feature_key(ntv["name"], ntv["term"])
+                        )
                         if idx is not None:
                             vmap[idx] = ntv["value"]
-                    vs = np.array([vmap.get(int(i), 0.0) for i in idxs])
                     any_var = True
+                # Support = union of means and variances: L1 solutions carry
+                # exact-zero means whose variances must survive the round
+                # trip.
+                idxs = np.asarray(
+                    sorted(set(mmap) | set(vmap)), dtype=np.int64
+                )
                 supports.append(idxs)
-                means_list.append(ms)
-                var_list.append(vs)
+                means_list.append(
+                    np.array([mmap.get(int(i), 0.0) for i in idxs])
+                )
+                var_list.append(
+                    np.array([vmap.get(int(i), 0.0) for i in idxs])
+                    if vmap else None
+                )
             e_cnt = len(records)
             s_max = max((s.size for s in supports), default=1)
             s_max = max(s_max, 1)
@@ -395,8 +393,14 @@ def save_scores(
 # --------------------------------------------------------------------------
 
 
+def _ckpt_path(path: str) -> str:
+    """np.savez appends .npz; normalize so save/load stay symmetric."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def save_checkpoint(model: GameModel, path: str) -> None:
     """One-file native GameModel checkpoint (.npz + JSON manifest)."""
+    path = _ckpt_path(path)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     arrays: dict[str, np.ndarray] = {}
     manifest: dict[str, dict] = {}
@@ -433,7 +437,7 @@ def save_checkpoint(model: GameModel, path: str) -> None:
 
 
 def load_checkpoint(path: str) -> GameModel:
-    with np.load(path) as z:
+    with np.load(_ckpt_path(path)) as z:
         manifest = json.loads(bytes(z["__manifest__"]).decode())
         models: dict[str, object] = {}
         for name, info in manifest.items():

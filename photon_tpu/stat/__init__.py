@@ -1,0 +1,3 @@
+from photon_tpu.stat.feature_stats import FeatureDataStatistics
+
+__all__ = ["FeatureDataStatistics"]

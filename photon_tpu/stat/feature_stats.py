@@ -1,0 +1,106 @@
+"""Per-feature summary statistics: one pass over the feature matrix.
+
+TPU-native counterpart of FeatureDataStatistics (photon-lib
+stat/FeatureDataStatistics.scala:44-139), which wraps Spark's
+MultivariateOnlineSummarizer: weighted per-feature mean / variance / min /
+max / numNonzeros over all rows, implicit zeros included. Feeds
+NormalizationContext construction (build_normalization_context) and the
+feature-stats Avro output of the training driver
+(GameTrainingDriver.calculateAndSaveFeatureShardStats :616-647).
+
+Moments come from the batch's fused matvec reductions (rmatvec /
+rmatvec_sq — device kernels); min/max/nnz are host-side numpy over the ELL
+slabs (computed once at ingest, like the reference's one summarizer pass).
+Variance uses the same unbiased weighted estimator as Spark's summarizer:
+  var_j = (sumW / (sumW - 1)) * (E[x^2] - E[x]^2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_tpu.data.dataset import DenseFeatures, Features, SparseFeatures
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureDataStatistics:
+    """Reference: stat/FeatureDataStatistics.scala:44."""
+
+    mean: np.ndarray  # [d] weighted mean
+    variance: np.ndarray  # [d] unbiased weighted variance
+    min: np.ndarray  # [d]
+    max: np.ndarray  # [d]
+    num_nonzeros: np.ndarray  # [d] weighted nnz count
+    count: float  # total weight
+    intercept_index: int | None = None
+
+    @property
+    def dim(self) -> int:
+        return self.mean.shape[0]
+
+    @staticmethod
+    def from_features(
+        features: Features,
+        weights: np.ndarray | None = None,
+        *,
+        intercept_index: int | None = None,
+    ) -> "FeatureDataStatistics":
+        if isinstance(features, DenseFeatures):
+            x = np.asarray(features.x, dtype=np.float64)
+            n, d = x.shape
+            w = np.ones(n) if weights is None else np.asarray(
+                weights, dtype=np.float64)
+            sum_w = float(w.sum())
+            mean = (w @ x) / sum_w
+            ex2 = (w @ (x * x)) / sum_w
+            mn = x.min(axis=0)
+            mx = x.max(axis=0)
+            nnz = (w[:, None] * (x != 0.0)).sum(axis=0)
+        else:
+            assert isinstance(features, SparseFeatures)
+            idx = np.asarray(features.indices)
+            val = np.asarray(features.values, dtype=np.float64)
+            n = idx.shape[0]
+            d = features.d
+            w = np.ones(n) if weights is None else np.asarray(
+                weights, dtype=np.float64)
+            sum_w = float(w.sum())
+            present = val != 0.0
+            flat_idx = idx[present]
+            flat_val = val[present]
+            flat_w = np.broadcast_to(w[:, None], idx.shape)[present]
+            s1 = np.zeros(d)
+            s2 = np.zeros(d)
+            nnz = np.zeros(d)
+            np.add.at(s1, flat_idx, flat_w * flat_val)
+            np.add.at(s2, flat_idx, flat_w * flat_val * flat_val)
+            np.add.at(nnz, flat_idx, flat_w)
+            mean = s1 / sum_w
+            ex2 = s2 / sum_w
+            # min/max over stored values; implicit zeros count whenever a
+            # column has any row without that feature.
+            mn = np.full(d, np.inf)
+            mx = np.full(d, -np.inf)
+            np.minimum.at(mn, flat_idx, flat_val)
+            np.maximum.at(mx, flat_idx, flat_val)
+            rows_per_col = np.zeros(d)
+            np.add.at(rows_per_col, flat_idx, 1.0)
+            has_zero = rows_per_col < n
+            mn = np.where(has_zero, np.minimum(mn, 0.0), mn)
+            mx = np.where(has_zero, np.maximum(mx, 0.0), mx)
+            mn = np.where(np.isinf(mn), 0.0, mn)
+            mx = np.where(np.isinf(mx), 0.0, mx)
+
+        correction = sum_w / max(sum_w - 1.0, 1.0)
+        variance = np.maximum(correction * (ex2 - mean * mean), 0.0)
+        return FeatureDataStatistics(
+            mean=mean,
+            variance=variance,
+            min=mn,
+            max=mx,
+            num_nonzeros=nnz,
+            count=sum_w,
+            intercept_index=intercept_index,
+        )

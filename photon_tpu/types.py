@@ -52,3 +52,10 @@ def make_feature_key(name: str, term: str = "") -> FeatureKey:
     Reference: Constants.DELIMITER usage in AvroDataReader.scala.
     """
     return f"{name}{DELIMITER}{term}"
+
+
+def split_feature_key(key: FeatureKey) -> tuple[str, str]:
+    """Inverse of make_feature_key (Utils.getFeatureNameFromKey /
+    getFeatureTermFromKey); keys without a delimiter have an empty term."""
+    parts = key.split(DELIMITER)
+    return (parts[0], parts[1]) if len(parts) == 2 else (parts[0], "")

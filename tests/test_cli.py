@@ -1,0 +1,185 @@
+"""CLI drivers: train -> model dir -> score round trip.
+
+Mirrors GameTrainingDriverIntegTest / GameScoringDriverIntegTest: run the
+full driver main() on synthetic Avro data, assert the output layout, the
+frozen-threshold metric, and scoring-side parity.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.io.avro_data import write_training_examples
+from photon_tpu.types import DELIMITER
+
+
+@pytest.fixture
+def glmix_avro(tmp_path, rng):
+    """Synthetic GLMix avro train/validation files with per-user effects."""
+    n, d, users = 1500, 5, 20
+    keys = [f"f{i}{DELIMITER}t" for i in range(d)]
+    u_eff = rng.normal(size=users)
+    w = rng.normal(size=d)
+
+    def write(path, n_rows, seed):
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(n_rows, d))
+        uid = r.integers(0, users, size=n_rows)
+        y = x @ w + u_eff[uid] + 0.1 * r.normal(size=n_rows)
+        rows = [
+            [(keys[j], float(x[i, j])) for j in range(d)]
+            for i in range(n_rows)
+        ]
+        meta = [{"userId": f"u{u}"} for u in uid]
+        write_training_examples(
+            str(path), y, rows, metadata=meta, uids=np.arange(n_rows)
+        )
+
+    train = tmp_path / "train.avro"
+    val = tmp_path / "val.avro"
+    write(train, n, 1)
+    write(val, 500, 2)
+    return train, val
+
+
+def _config(tmp_path, train, val, **overrides):
+    cfg = {
+        "task": "LINEAR_REGRESSION",
+        "input": {
+            "format": "avro",
+            "train_path": str(train),
+            "validation_path": str(val),
+            "id_tags": ["userId"],
+        },
+        "coordinates": {
+            "global": {
+                "type": "fixed",
+                "regularization": {"type": "L2", "weights": [0.01]},
+            },
+            "per-user": {
+                "type": "random",
+                "random_effect_type": "userId",
+                "regularization": {"type": "L2", "weights": [1.0]},
+            },
+        },
+        "num_iterations": 2,
+        "evaluators": ["RMSE"],
+        "output_dir": str(tmp_path / "out"),
+    }
+    cfg.update(overrides)
+    path = tmp_path / "config.json"
+    path.write_text(json.dumps(cfg))
+    return path, cfg
+
+
+class TestTrainCLI:
+    def test_end_to_end(self, tmp_path, glmix_avro, capsys):
+        from photon_tpu.cli.train import main
+
+        train, val = glmix_avro
+        cfg_path, _ = _config(tmp_path, train, val)
+        assert main(["--config", str(cfg_path)]) == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        # GLMix must land near the 0.1 noise floor (frozen threshold, the
+        # GameTrainingDriverIntegTest RMSE < 1.697 pattern).
+        assert out["evaluation"]["RMSE"] < 0.3
+
+        out_dir = tmp_path / "out"
+        assert (out_dir / "training-summary.json").is_file()
+        model_dir = out_dir / "models" / "best"
+        assert (model_dir / "model-metadata.json").is_file()
+        assert (model_dir / "fixed-effect" / "global" / "id-info").is_file()
+        assert (model_dir / "random-effect" / "per-user" / "id-info").is_file()
+        assert (model_dir / "checkpoint.npz").is_file()
+
+    def test_lambda_grid_selects_best(self, tmp_path, glmix_avro, capsys):
+        from photon_tpu.cli.train import main
+
+        train, val = glmix_avro
+        cfg_path, _ = _config(
+            tmp_path, train, val,
+            coordinates={
+                "global": {
+                    "type": "fixed",
+                    "regularization": {
+                        "type": "L2", "weights": [1000.0, 0.01]},
+                },
+            },
+            model_output_mode="ALL",
+        )
+        assert main(["--config", str(cfg_path)]) == 0
+        summary = json.loads(
+            (tmp_path / "out" / "training-summary.json").read_text())
+        assert summary["num_configurations"] == 2
+        # Lambdas expand sorted descending; the weak one must win.
+        lams = [c["config"]["global"]["lambda"]
+                for c in summary["configurations"]]
+        assert lams == [1000.0, 0.01]
+        assert summary["best_configuration_index"] == 1
+        assert (tmp_path / "out" / "models" / "config_0").is_dir()
+        assert (tmp_path / "out" / "models" / "config_1").is_dir()
+
+    def test_libsvm_input(self, tmp_path, rng, capsys):
+        from photon_tpu.cli.train import main
+
+        n, d = 400, 6
+        x = rng.normal(size=(n, d))
+        w = rng.normal(size=d)
+        y = (x @ w + 0.5 * rng.normal(size=n) > 0).astype(int)
+        lines = []
+        for i in range(n):
+            feats = " ".join(
+                f"{j + 1}:{x[i, j]:.6f}" for j in range(d))
+            lines.append(f"{2 * y[i] - 1} {feats}")
+        p = tmp_path / "a1a.txt"
+        p.write_text("\n".join(lines))
+        cfg_path, _ = _config(
+            tmp_path, p, None,
+            task="LOGISTIC_REGRESSION",
+            input={"format": "libsvm", "train_path": str(p),
+                   "validation_path": str(p)},
+            coordinates={
+                "global": {
+                    "type": "fixed",
+                    "regularization": {"type": "L2", "weights": [0.1]},
+                },
+            },
+            evaluators=["AUC"],
+            normalization="STANDARDIZATION",
+        )
+        assert main(["--config", str(cfg_path)]) == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["evaluation"]["AUC"] > 0.85
+
+
+class TestScoreCLI:
+    def test_train_then_score(self, tmp_path, glmix_avro, capsys):
+        from photon_tpu.cli.score import main as score_main
+        from photon_tpu.cli.train import main as train_main
+        from photon_tpu.io import avro
+
+        train, val = glmix_avro
+        cfg_path, _ = _config(tmp_path, train, val)
+        assert train_main(["--config", str(cfg_path)]) == 0
+        capsys.readouterr()
+
+        score_out = tmp_path / "scores"
+        rc = score_main([
+            "--model-dir", str(tmp_path / "out" / "models" / "best"),
+            "--input", str(val),
+            "--output", str(score_out),
+            "--evaluators", "RMSE",
+            "--id-tags", "userId",
+        ])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["num_scored"] == 500
+        # Scoring-side eval matches the training validation metric regime.
+        assert out["evaluation"]["RMSE"] < 0.3
+        recs = avro.read_container(
+            str(score_out / "part-00000.avro"))[1]
+        assert len(recs) == 500
+        assert np.isfinite([r["predictionScore"] for r in recs]).all()
+        assert (score_out / "evaluation.json").is_file()

@@ -1,0 +1,58 @@
+"""FeatureDataStatistics: dense/sparse parity, weighted moments, zeros."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.dataset import DenseFeatures, SparseFeatures, rows_to_ell
+from photon_tpu.stat import FeatureDataStatistics
+
+
+def test_dense_weighted_moments(rng):
+    n, d = 200, 5
+    x = rng.normal(size=(n, d))
+    w = rng.uniform(0.5, 2.0, size=n)
+    stats = FeatureDataStatistics.from_features(
+        DenseFeatures(jnp.asarray(x)), w)
+    sum_w = w.sum()
+    mean = (w @ x) / sum_w
+    np.testing.assert_allclose(stats.mean, mean, rtol=1e-6)
+    var = sum_w / (sum_w - 1) * ((w @ (x * x)) / sum_w - mean**2)
+    np.testing.assert_allclose(stats.variance, var, rtol=1e-5)
+    np.testing.assert_allclose(stats.min, x.min(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(stats.max, x.max(axis=0), rtol=1e-6)
+
+
+def test_sparse_matches_dense_with_implicit_zeros(rng):
+    n, d = 100, 6
+    dense = np.zeros((n, d))
+    rows = []
+    for i in range(n):
+        nz = rng.choice(d, size=2, replace=False)
+        row = []
+        for j in nz:
+            v = float(rng.normal())
+            dense[i, j] = v
+            row.append((int(j), v))
+        rows.append(row)
+    idx, val = rows_to_ell(rows, d)
+    w = rng.uniform(0.5, 2.0, size=n)
+    s_sparse = FeatureDataStatistics.from_features(
+        SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d), w)
+    s_dense = FeatureDataStatistics.from_features(
+        DenseFeatures(jnp.asarray(dense)), w)
+    for field in ("mean", "variance", "min", "max"):
+        np.testing.assert_allclose(
+            getattr(s_sparse, field), getattr(s_dense, field),
+            rtol=1e-6, atol=1e-12, err_msg=field)
+    # nnz counts weights of stored nonzeros only.
+    np.testing.assert_allclose(
+        s_sparse.num_nonzeros,
+        (w[:, None] * (dense != 0)).sum(axis=0), rtol=1e-6)
+
+
+def test_constant_column_zero_variance(rng):
+    x = np.ones((50, 2))
+    x[:, 0] = rng.normal(size=50)
+    stats = FeatureDataStatistics.from_features(DenseFeatures(jnp.asarray(x)))
+    assert stats.variance[1] == 0.0
+    assert stats.variance[0] > 0.0

@@ -192,6 +192,39 @@ class TestGameModelIO:
             out, "fixed-effect", "global", "coefficients"))
         assert recs[0]["means"] == []
 
+    def test_zero_mean_variance_survives_round_trip(self, rng, tmp_path):
+        """L1 solutions have exact-zero means with meaningful variances;
+        the RE loader must key variances on the union support."""
+        proj = np.array([[0, 2, -1]], dtype=np.int64)
+        model = GameModel({"per-user": RandomEffectModel(
+            coefficients=jnp.asarray([[1.5, 0.0, 0.0]]),
+            random_effect_type="userId",
+            feature_shard_id="shardB",
+            task=TaskType.LINEAR_REGRESSION,
+            proj_all=proj,
+            variances=jnp.asarray([[0.3, 0.7, 0.0]]),
+            entity_keys=("u0",),
+        )})
+        imaps = {"shardB": _index_map(6)}
+        out = str(tmp_path / "m")
+        save_game_model(model, out, imaps)
+        loaded, _ = load_game_model(out, imaps)
+        got = loaded["per-user"]
+        slot = np.nonzero(got.proj_all[0] == 2)[0]
+        assert slot.size == 1
+        assert float(got.variances[0, slot[0]]) == pytest.approx(0.7)
+        assert float(got.coefficients[0, slot[0]]) == 0.0
+
+    def test_checkpoint_suffix_normalized(self, rng, tmp_path):
+        model = _game_model(rng)
+        p = str(tmp_path / "ckpt")  # no .npz suffix
+        save_checkpoint(model, p)
+        loaded = load_checkpoint(p)
+        np.testing.assert_allclose(
+            np.asarray(loaded["global"].model.coefficients.means),
+            np.asarray(model["global"].model.coefficients.means),
+        )
+
     def test_checkpoint_round_trip(self, rng, tmp_path):
         model = _game_model(rng)
         p = str(tmp_path / "ckpt.npz")
@@ -239,6 +272,15 @@ class TestTrainingDataIO:
                 for k, v in rows[0]}
         want[imap.intercept_index] = 1.0
         assert row0 == want
+
+    def test_no_intercept_flag_respected(self, tmp_path, rng):
+        p = str(tmp_path / "t.avro")
+        write_training_examples(
+            p, [1.0], [[(f"f0{DELIMITER}t", 2.0)]])
+        game, imap = read_training_examples(p, add_intercept=False)
+        assert not imap.has_intercept
+        vals = np.asarray(game.feature_shards["features"].values[0])
+        assert (vals != 1.0).all()  # no injected intercept column
 
     def test_scores_writer(self, tmp_path, rng):
         p = str(tmp_path / "scores.avro")
