@@ -248,20 +248,27 @@ class RandomEffectCoordinate:
         )
         reasons: list[np.ndarray] = []
         iters: list[np.ndarray] = []
+        # Mesh-sharded blocks pad the entity axis with inert entities
+        # (code == num_entities); static per dataset, computed once.
+        real_masks = [
+            np.asarray(b.entity_codes) < ds.num_entities for b in ds.blocks
+        ]
 
         if self.normalization.shifts is not None:
             # Shift normalization folds the shift mass into the intercept on
             # the coefficient round trip; every trained entity must have one
             # (the per-entity analog of NormalizationContext.__post_init__).
-            for block in ds.blocks:
-                if bool((np.asarray(block.intercept_slots) < 0).any()):
+            for block, real in zip(ds.blocks, real_masks):
+                if bool(
+                    (np.asarray(block.intercept_slots)[real] < 0).any()
+                ):
                     raise ValueError(
                         "normalization with shifts requires every entity's "
                         "subspace to contain the intercept; build the "
                         "dataset with intercept_index set"
                     )
 
-        for block in ds.blocks:
+        for block, real in zip(ds.blocks, real_masks):
             s = block.sub_dim
             offsets = block.offsets
             if residuals is not None:
@@ -298,8 +305,8 @@ class RandomEffectCoordinate:
             w_all = w_all.at[block.entity_codes].set(w)
             if v_all is not None:
                 v_all = v_all.at[block.entity_codes].set(v)
-            reasons.append(np.asarray(reason))
-            iters.append(np.asarray(it))
+            reasons.append(np.asarray(reason)[real])
+            iters.append(np.asarray(it)[real])
 
         model = RandomEffectModel(
             coefficients=w_all,

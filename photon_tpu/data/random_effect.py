@@ -113,7 +113,12 @@ class RandomEffectDataset:
 
     @property
     def num_active_entities(self) -> int:
-        return sum(b.num_entities for b in self.blocks)
+        # Mesh-sharded blocks pad the entity axis with inert entities whose
+        # code is num_entities; count only real ones.
+        return sum(
+            int((np.asarray(b.entity_codes) < self.num_entities).sum())
+            for b in self.blocks
+        )
 
 
 def _stable_type_seed(re_type: str) -> np.uint64:

@@ -26,6 +26,66 @@ from photon_tpu.data.dataset import GLMBatch, pad_batch
 DATA_AXIS = "data"
 
 
+def shard_random_effect_dataset(
+    ds, mesh: Mesh, *, axis_name: str = DATA_AXIS
+):
+    """Shard a RandomEffectDataset's entity axis over the mesh (ep).
+
+    Each size bucket's entity axis is padded to a multiple of the device
+    count with inert entities (weight 0, empty subspace, entity code ==
+    num_entities so their scatter back into the coefficient matrix is
+    dropped as out-of-bounds), then every block leaf is placed with its
+    leading axis sharded. The per-entity solves are embarrassingly parallel
+    (RandomEffectCoordinate.scala:243-292 runs them executor-local), so
+    sharding the vmapped solver's batch axis keeps all solver FLOPs local
+    to each device — the TPU analog of the reference's entity partitioning
+    (RandomEffectDatasetPartitioner.scala:44).
+
+    The scoring table's row axis is sharded too when evenly divisible
+    (otherwise left as-is: scoring is one gather-multiply-reduce either way).
+    """
+    import dataclasses
+
+    from photon_tpu.data.random_effect import EntityBlocks
+
+    n_dev = mesh.shape[axis_name]
+
+    def place(leaf):
+        return jax.device_put(
+            leaf, row_sharding(mesh, np.ndim(leaf), axis_name=axis_name)
+        )
+
+    import jax.numpy as jnp
+
+    def pad_block(b: EntityBlocks) -> EntityBlocks:
+        pad = (-b.num_entities) % n_dev
+        if pad:
+            fills = {"entity_codes": ds.num_entities,
+                     "proj": -1, "intercept_slots": -1}
+
+            def pad_leaf(name, leaf):
+                widths = [(0, pad)] + [(0, 0)] * (np.ndim(leaf) - 1)
+                return jnp.pad(
+                    leaf, widths, constant_values=fills.get(name, 0)
+                )
+
+            b = EntityBlocks(**{
+                f.name: pad_leaf(f.name, getattr(b, f.name))
+                for f in dataclasses.fields(EntityBlocks)
+            })
+        return jax.tree.map(place, b)
+
+    blocks = tuple(pad_block(b) for b in ds.blocks)
+    rep = {"blocks": blocks}
+    if ds.score_codes.shape[0] % n_dev == 0:
+        rep.update(
+            score_codes=place(ds.score_codes),
+            score_indices=place(ds.score_indices),
+            score_values=place(ds.score_values),
+        )
+    return dataclasses.replace(ds, **rep)
+
+
 def make_mesh(
     devices=None, *, axis_name: str = DATA_AXIS
 ) -> Mesh:
