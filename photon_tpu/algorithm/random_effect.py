@@ -248,11 +248,7 @@ class RandomEffectCoordinate:
         )
         reasons: list[np.ndarray] = []
         iters: list[np.ndarray] = []
-        # Mesh-sharded blocks pad the entity axis with inert entities
-        # (code == num_entities); static per dataset, computed once.
-        real_masks = [
-            np.asarray(b.entity_codes) < ds.num_entities for b in ds.blocks
-        ]
+        real_masks = [ds.real_entity_mask(b) for b in ds.blocks]
 
         if self.normalization.shifts is not None:
             # Shift normalization folds the shift mass into the intercept on

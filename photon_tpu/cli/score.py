@@ -53,14 +53,14 @@ def main(argv=None) -> int:
     from photon_tpu.io.model_io import load_game_model, save_scores
     from photon_tpu.transformers import GameTransformer
 
-    # Feature index from the scoring data's own keys PLUS the model's: the
-    # reference resolves keys through the same feature maps used at training
-    # time; here the model files name features explicitly, so the union map
-    # reproduces the training indices for every known feature.
+    # Feature index built from the scoring data's keys. Model features absent
+    # from the data are dropped at model load; that is harmless — a feature
+    # no row carries contributes zero margin either way.
     records = avro.read_container_dir(args.input)
     index_map = build_index_map_from_records(records)
     data, _ = read_training_examples(
-        args.input, index_map=index_map, id_tag_names=args.id_tags
+        args.input, index_map=index_map, id_tag_names=args.id_tags,
+        records=records,
     )
     # Every shard named by the model resolves against the data's single
     # feature table.

@@ -31,8 +31,8 @@ class IdTag:
     """One grouping column: dense int codes + the key vocabulary."""
 
     codes: Array  # [n] int32
-    vocab: dict  # raw key -> code
-    inverse: tuple  # code -> raw key
+    vocab: dict  # str key -> code
+    inverse: tuple  # code -> str key
 
     @property
     def num_groups(self) -> int:
@@ -40,9 +40,19 @@ class IdTag:
 
     @staticmethod
     def from_raw(raw_ids) -> "IdTag":
+        # Entity keys are normalized to str at ingest: the Avro model format
+        # stores modelId as a string (BayesianLinearModelAvro), so keeping
+        # numeric keys here would make every vocab lookup after a model
+        # reload miss silently ('5' vs np.int64(5)).
         raw = np.asarray(raw_ids)
         uniq, codes = np.unique(raw, return_inverse=True)
-        keys = tuple(k.item() if hasattr(k, "item") else k for k in uniq)
+        keys = tuple(
+            str(k.item() if hasattr(k, "item") else k) for k in uniq
+        )
+        if len(set(keys)) != len(keys):
+            raise ValueError(
+                "id tag keys collide after str normalization"
+            )
         return IdTag(
             codes=jnp.asarray(codes.astype(np.int32)),
             vocab={k: i for i, k in enumerate(keys)},

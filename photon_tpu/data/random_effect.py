@@ -111,13 +111,17 @@ class RandomEffectDataset:
     proj_all: np.ndarray  # [E, max_sub_dim] original feature ids; -1 pad
     num_features: int  # original feature-space dim of the shard
 
+    def real_entity_mask(self, block: EntityBlocks) -> np.ndarray:
+        """[B] bool — True for real entities. Mesh-sharded blocks pad the
+        entity axis with inert entities whose code is ``num_entities``
+        (parallel/mesh.py shard_random_effect_dataset); this helper owns
+        that sentinel convention."""
+        return np.asarray(block.entity_codes) < self.num_entities
+
     @property
     def num_active_entities(self) -> int:
-        # Mesh-sharded blocks pad the entity axis with inert entities whose
-        # code is num_entities; count only real ones.
         return sum(
-            int((np.asarray(b.entity_codes) < self.num_entities).sum())
-            for b in self.blocks
+            int(self.real_entity_mask(b).sum()) for b in self.blocks
         )
 
 
@@ -278,11 +282,20 @@ def remap_for_scoring(
     if dtype is None:
         dtype = game_data.labels.dtype
     tag = game_data.id_tags[re_type]
-    vocab = {k: i for i, k in enumerate(entity_keys)}
+    vocab = {str(k): i for i, k in enumerate(entity_keys)}
     # this-dataset code -> trained code (-1 unseen)
     code_map = np.array(
-        [vocab.get(k, -1) for k in tag.inverse], dtype=np.int64
+        [vocab.get(str(k), -1) for k in tag.inverse], dtype=np.int64
     )
+    if len(tag.inverse) and len(entity_keys) and (code_map < 0).all():
+        import warnings
+
+        warnings.warn(
+            f"remap_for_scoring({re_type!r}): none of {len(tag.inverse)} "
+            f"dataset entities match the {len(entity_keys)} model entities "
+            "— every random-effect score will be 0",
+            stacklevel=2,
+        )
     codes = code_map[np.asarray(tag.codes)]
 
     ell_idx, ell_val, num_features = _rows_to_coo(
@@ -402,7 +415,10 @@ def build_random_effect_dataset(
     bucket_of: dict[int, list[int]] = {}
     for e in active_ids:
         r = entity_rows[e].size
-        cap = next((c for c in caps if r <= c), r)
+        # Entities above the largest cap round up to the next power of two so
+        # heavy-tailed size distributions share padded shapes (and jit
+        # compiles of the solver) instead of one shape per distinct size.
+        cap = next((c for c in caps if r <= c), 1 << (r - 1).bit_length())
         bucket_of.setdefault(cap, []).append(int(e))
 
     blocks = []

@@ -201,9 +201,12 @@ def _matches(schema, datum) -> bool:
         return {
             "null": datum is None,
             "boolean": isinstance(datum, bool),
-            "int": isinstance(datum, int) and not isinstance(datum, bool),
-            "long": isinstance(datum, int) and not isinstance(datum, bool),
-            "float": isinstance(datum, float),
+            "int": (isinstance(datum, int) and not isinstance(datum, bool)
+                    and -(2 ** 31) <= datum < 2 ** 31),
+            "long": (isinstance(datum, int) and not isinstance(datum, bool)
+                     and -(2 ** 63) <= datum < 2 ** 63),
+            "float": (isinstance(datum, (float, int))
+                      and not isinstance(datum, bool)),
             "double": isinstance(datum, (float, int)) and not isinstance(datum, bool),
             "string": isinstance(datum, str),
             "bytes": isinstance(datum, (bytes, bytearray)),

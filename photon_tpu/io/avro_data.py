@@ -47,13 +47,16 @@ def read_training_examples(
     id_tag_names: list[str] | None = None,
     add_intercept: bool = True,
     dtype=jnp.float32,
+    records: list[dict] | None = None,
 ) -> tuple[GameDataset, IndexMap]:
     """Read a TrainingExampleAvro file/dir into a GameDataset.
 
     ``id_tag_names`` picks metadataMap entries to expose as id tags; when
-    None all metadata keys found in the first record are used.
+    None all metadata keys found in the first record are used. ``records``
+    supplies already-parsed Avro records for ``path`` to skip a re-parse.
     """
-    records = avro.read_container_dir(path)
+    if records is None:
+        records = avro.read_container_dir(path)
     if not records:
         raise ValueError(f"no records in {path}")
     if index_map is None:

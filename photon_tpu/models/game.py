@@ -147,7 +147,18 @@ def remap_random_effect_model(
     dtype = w_old.dtype
     w = np.zeros((e_new, s_new), dtype=dtype)
     v = None if v_old is None else np.zeros((e_new, s_new), dtype=dtype)
-    old_vocab = {k: i for i, k in enumerate(model.entity_keys)}
+    old_vocab = {str(k): i for i, k in enumerate(model.entity_keys)}
+    n_hit = sum(1 for k in entity_keys if str(k) in old_vocab)
+    if entity_keys and model.entity_keys and n_hit == 0:
+        import warnings
+
+        warnings.warn(
+            f"remap_random_effect_model({model.random_effect_type!r}): none "
+            f"of {len(entity_keys)} dataset entities match the "
+            f"{len(model.entity_keys)} model entities — the warm start is "
+            "effectively a zero model",
+            stacklevel=2,
+        )
     max_feat = 0
     if proj_all.size:
         max_feat = max(max_feat, int(proj_all.max(initial=0)))
@@ -155,7 +166,7 @@ def remap_random_effect_model(
         max_feat = max(max_feat, int(model.proj_all.max(initial=0)))
     lut = np.full(max_feat + 1, -1, dtype=np.int64)
     for en, key in enumerate(entity_keys):
-        eo = old_vocab.get(key)
+        eo = old_vocab.get(str(key))
         if eo is None:
             continue
         old_p = model.proj_all[eo]

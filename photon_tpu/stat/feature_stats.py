@@ -55,8 +55,15 @@ class FeatureDataStatistics:
             sum_w = float(w.sum())
             mean = (w @ x) / sum_w
             ex2 = (w @ (x * x)) / sum_w
-            mn = x.min(axis=0)
-            mx = x.max(axis=0)
+            # Spark's MultivariateOnlineSummarizer skips non-positive-weight
+            # rows entirely; keep min/max parity by masking them out.
+            xw = x[w > 0.0]
+            if xw.shape[0] == 0:
+                mn = np.zeros(d)
+                mx = np.zeros(d)
+            else:
+                mn = xw.min(axis=0)
+                mx = xw.max(axis=0)
             nnz = (w[:, None] * (x != 0.0)).sum(axis=0)
         else:
             assert isinstance(features, SparseFeatures)
@@ -67,7 +74,10 @@ class FeatureDataStatistics:
             w = np.ones(n) if weights is None else np.asarray(
                 weights, dtype=np.float64)
             sum_w = float(w.sum())
-            present = val != 0.0
+            # Zero-weight rows are skipped entirely (min/max, nnz, implicit-
+            # zero detection), matching Spark's MultivariateOnlineSummarizer.
+            present = (val != 0.0) & (w[:, None] > 0.0)
+            n_pos = int((w > 0.0).sum())
             flat_idx = idx[present]
             flat_val = val[present]
             flat_w = np.broadcast_to(w[:, None], idx.shape)[present]
@@ -87,7 +97,7 @@ class FeatureDataStatistics:
             np.maximum.at(mx, flat_idx, flat_val)
             rows_per_col = np.zeros(d)
             np.add.at(rows_per_col, flat_idx, 1.0)
-            has_zero = rows_per_col < n
+            has_zero = rows_per_col < n_pos
             mn = np.where(has_zero, np.minimum(mn, 0.0), mn)
             mx = np.where(has_zero, np.maximum(mx, 0.0), mx)
             mn = np.where(np.isinf(mn), 0.0, mn)

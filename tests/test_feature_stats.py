@@ -56,3 +56,28 @@ def test_constant_column_zero_variance(rng):
     stats = FeatureDataStatistics.from_features(DenseFeatures(jnp.asarray(x)))
     assert stats.variance[1] == 0.0
     assert stats.variance[0] > 0.0
+
+
+def test_zero_weight_rows_skipped(rng):
+    """Spark's MultivariateOnlineSummarizer skips weight-0 rows entirely:
+    they must not leak into min/max or implicit-zero detection."""
+    # Dense: an extreme outlier row with weight 0.
+    x = np.array([[1.0, 2.0], [3.0, 4.0], [-99.0, 99.0]])
+    w = np.array([1.0, 1.0, 0.0])
+    s = FeatureDataStatistics.from_features(DenseFeatures(jnp.asarray(x)), w)
+    np.testing.assert_allclose(s.min, [1.0, 2.0])
+    np.testing.assert_allclose(s.max, [3.0, 4.0])
+
+    # Sparse: the only row missing feature 0 has weight 0, so feature 0 has
+    # no implicit zero among weighted rows and its min stays positive.
+    rows = [[(0, 2.0), (1, 1.0)], [(0, 5.0)], [(1, -7.0)]]
+    idx, val = rows_to_ell(rows, 2)
+    w = np.array([1.0, 1.0, 0.0])
+    s = FeatureDataStatistics.from_features(
+        SparseFeatures(jnp.asarray(idx), jnp.asarray(val), 2), w)
+    np.testing.assert_allclose(s.min[0], 2.0)
+    np.testing.assert_allclose(s.max[0], 5.0)
+    # Feature 1 IS missing from weighted row 1 -> implicit zero.
+    np.testing.assert_allclose(s.min[1], 0.0)
+    np.testing.assert_allclose(s.max[1], 1.0)
+    np.testing.assert_allclose(s.num_nonzeros, [2.0, 1.0])

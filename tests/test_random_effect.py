@@ -263,3 +263,29 @@ class TestRandomEffectCoordinate:
             rtol=5e-4,
             atol=5e-5,
         )
+
+
+class TestBucketCapRounding:
+    def test_large_entities_share_power_of_two_buckets(self, rng):
+        """Entities above the top bucket cap round up to the next power of
+        two so distinct large sizes share padded shapes (and solver jit
+        compiles) instead of one bucket per exact row count."""
+        sizes = {0: 9000, 1: 9100, 2: 9200, 3: 20000}
+        entities = np.concatenate(
+            [np.full(c, e) for e, c in sizes.items()]
+        )
+        n = entities.size
+        x = rng.normal(size=(n, 3))
+        game = make_game_dataset(
+            rng.normal(size=n),
+            {"shard": DenseFeatures(jnp.asarray(x))},
+            id_tags={"userId": entities},
+            dtype=jnp.float64,
+        )
+        ds = build_random_effect_dataset(
+            game, RandomEffectDataConfiguration("userId", "shard")
+        )
+        caps = sorted(b.weights.shape[1] for b in ds.blocks)
+        # 9000/9100/9200 -> one shared 16384 bucket; 20000 -> 32768.
+        assert caps == [16384, 32768]
+        assert ds.blocks[0].num_entities + ds.blocks[1].num_entities == 4
