@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +57,11 @@ class GLMOptimizationConfiguration:
     regularization_weight: float = 0.0
     down_sampling_rate: float = 1.0
     variance_computation: VarianceComputationType = VarianceComputationType.NONE
+    # Hyperparameter-tuning search ranges (CoordinateOptimizationConfiguration
+    # .scala:40-41 regularizationWeightRange / elasticNetParamRange); None
+    # means the tuner's defaults apply.
+    regularization_weight_range: tuple[float, float] | None = None
+    elastic_net_param_range: tuple[float, float] | None = None
 
     def with_regularization_weight(self, weight: float) -> "GLMOptimizationConfiguration":
         """Warm-start lambda update
@@ -182,38 +188,99 @@ class GLMOptimizationProblem:
         initial (original-space) coefficients are mapped to transformed space,
         the solver runs there against the raw data via effective coefficients,
         and means/variances are mapped back.
+
+        The whole solve runs under ONE cached ``jax.jit`` with the l1/l2
+        weights as *traced* scalars, so coordinate-descent iterations, the
+        warm-start lambda ladder, and hyperparameter tuning all reuse one
+        compiled program per (shapes, optimizer config) — the reference pays
+        a broadcast + treeAggregate per iteration instead
+        (ValueAndGradientAggregator.scala:299-320).
         """
         d = batch.num_features
         dtype = batch.labels.dtype
         w0_orig = (initial.means if initial is not None
                    else jnp.zeros(d, dtype=dtype))
-        w0 = self.normalization.coef_to_transformed_space(w0_orig)
 
-        fun = glm_ops.make_value_and_grad(batch, self.loss, self.normalization)
-        hvp = None
-        if self.config.optimizer.optimizer_type == optim.OptimizerType.TRON:
-            hvp = glm_ops.make_hvp(batch, self.loss, self.normalization)
-
-        result = optim.solve(
-            fun,
-            w0,
-            self.config.optimizer,
-            l1_weight=self.config.l1_weight,
-            l2_weight=self.config.l2_weight,
-            intercept_index=self.intercept_index,
-            hvp=hvp,
-        )
-
-        variances = compute_variances(
+        cfg = self.config
+        use_owlqn = cfg.l1_weight != 0.0
+        # Box-constraint arrays make the optimizer config unhashable; that
+        # rare path runs untraced (the constraints become trace constants).
+        run = _run_jit if cfg.optimizer.box_constraints is None else _run_impl
+        means, variances, result = run(
             batch,
-            self.loss,
-            result.coefficients,
+            jnp.asarray(w0_orig, dtype=dtype),
+            jnp.asarray(cfg.l1_weight, dtype=dtype),
+            jnp.asarray(cfg.l2_weight, dtype=dtype),
             self.normalization,
-            self.config.l2_weight,
-            self.intercept_index,
-            self.config.variance_computation,
+            task=self.task,
+            opt_config=cfg.optimizer,
+            use_owlqn=use_owlqn,
+            intercept_index=self.intercept_index,
+            variance_computation=cfg.variance_computation,
         )
-        means = self.normalization.coef_to_original_space(result.coefficients)
         model = GeneralizedLinearModel(
             Coefficients(means=means, variances=variances), self.task)
         return GLMSolution(model=model, result=result)
+
+
+def _run_impl(
+    batch: GLMBatch,
+    w0_orig: Array,
+    l1_weight: Array,
+    l2_weight: Array,
+    norm: NormalizationContext,
+    *,
+    task: TaskType,
+    opt_config: optim.OptimizerConfig,
+    use_owlqn: bool,
+    intercept_index: int | None,
+    variance_computation: VarianceComputationType,
+):
+    """One fused program: transform -> solve -> variances -> round trip.
+
+    Regularization weights are traced operands: a new lambda re-runs the
+    cached executable instead of recompiling (the warm-start ladder of
+    DistributedOptimizationProblem.updateRegularizationWeight :64 and the
+    tuner's retrains hit the same trace). Solver routing is static: OWL-QN
+    whenever the config carries an L1 part (OptimizerFactory semantics).
+    """
+    loss = losses_mod.get_loss(task)
+    w0 = norm.coef_to_transformed_space(w0_orig)
+    fun = glm_ops.make_value_and_grad(batch, loss, norm)
+    obj = optim.with_l2(fun, l2_weight, intercept_index)
+
+    if use_owlqn:
+        result = optim.owlqn_solve(obj, w0, l1_weight, opt_config)
+    elif opt_config.optimizer_type == optim.OptimizerType.TRON:
+        hvp = optim.with_l2_hvp(
+            glm_ops.make_hvp(batch, loss, norm), l2_weight, intercept_index
+        )
+        result = optim.tron_solve(obj, hvp, w0, opt_config)
+    else:
+        result = optim.lbfgs_solve(obj, w0, opt_config)
+
+    if variance_computation == VarianceComputationType.NONE:
+        variances = None
+    else:
+        d = w0_orig.shape[-1]
+        l2_diag = jnp.full((d,), l2_weight, dtype=w0_orig.dtype)
+        if intercept_index is not None:
+            l2_diag = l2_diag.at[intercept_index].set(0.0)
+        variances = variances_in_transformed_space(
+            batch, loss, result.coefficients, norm, l2_diag,
+            variance_computation,
+        )
+        if norm.factors is not None:
+            variances = variances * norm.factors * norm.factors
+    means = norm.coef_to_original_space(result.coefficients)
+    return means, variances, result
+
+
+
+_run_jit = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "task", "opt_config", "use_owlqn", "intercept_index",
+        "variance_computation",
+    ),
+)(_run_impl)

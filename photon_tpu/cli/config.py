@@ -87,11 +87,20 @@ def parse_coordinate(cid: str, d: dict) -> CoordinateSpec:
             d.get("variance_computation", "NONE").upper()
         ),
     )
-    reg, lambdas = _parse_regularization(d.get("regularization", {}))
+    reg_dict = d.get("regularization", {})
+    reg, lambdas = _parse_regularization(reg_dict)
     opt_cfg = dataclasses.replace(
         opt_cfg,
         regularization=reg,
         regularization_weight=lambdas[0] if lambdas else 0.0,
+        regularization_weight_range=(
+            tuple(reg_dict["weight_range"])
+            if "weight_range" in reg_dict else None
+        ),
+        elastic_net_param_range=(
+            tuple(reg_dict["alpha_range"])
+            if "alpha_range" in reg_dict else None
+        ),
     )
     shard = d.get("feature_shard", "features")
     kind = d.get("type", "fixed").lower()

@@ -160,6 +160,45 @@ def main(argv=None) -> int:
     )
 
     # ------------------------------------------------------------------
+    # hyperparameter tuning (runHyperparameterTuning :677-719)
+    # ------------------------------------------------------------------
+    num_tuned = 0
+    tuning = cfg.hyperparameter_tuning or {}
+    tuning_mode = str(tuning.get("mode", "NONE")).upper()
+    if tuning_mode != "NONE" and validation is None:
+        log.warning(
+            "hyperparameter tuning (%s) requested but no validation_path is "
+            "configured; skipping", tuning_mode)
+    elif tuning_mode != "NONE":
+        from photon_tpu import hyperparameter
+
+        base_config = results[0].config
+        evaluator = results[0].evaluation.primary_evaluator
+        evaluation_function = (
+            hyperparameter.GameEstimatorEvaluationFunction(
+                estimator, base_config, train, validation,
+                is_opt_max=evaluator.bigger_is_better,
+            ))
+        if evaluation_function.num_params == 0:
+            log.warning(
+                "hyperparameter tuning requested but no coordinate has a "
+                "tunable regularization; skipping")
+        else:
+            observations = evaluation_function.convert_observations(results)
+            tuned = hyperparameter.search(
+                int(tuning.get("iterations", 10)),
+                evaluation_function.num_params,
+                tuning_mode,
+                evaluation_function,
+                observations,
+                seed=int(tuning.get("seed", 0)),
+            )
+            num_tuned = len(tuned)
+            log.info("hyperparameter tuning (%s) evaluated %d candidate(s)",
+                     tuning_mode, num_tuned)
+            results = results + tuned
+
+    # ------------------------------------------------------------------
     # model selection + save (selectBestModel :753, saveModelToHDFS :804)
     # ------------------------------------------------------------------
     best = estimator.select_best(results)
@@ -179,6 +218,7 @@ def main(argv=None) -> int:
     summary = {
         "task": cfg.task.value,
         "num_configurations": len(results),
+        "num_tuned_configurations": num_tuned,
         "best_configuration_index": best_idx,
         "configurations": [
             {

@@ -287,12 +287,24 @@ class GameEstimator:
         (warm-start / partial-retrain model loading,
         GameTrainingDriver.scala:395-404).
         """
-        datasets = self._build_datasets(data, initial_model)
-        val_ctx = (
-            self._build_validation(datasets, validation)
-            if validation is not None
-            else None
-        )
+        # Repeated fits on the same data (the lambda grid re-entered by the
+        # hyperparameter tuner, GameEstimatorEvaluationFunction.scala:40)
+        # reuse the ingested device datasets: the build is the expensive
+        # host-side step and is pure in (data, initial_model).
+        cache_key = (data, initial_model, validation)
+        cached = getattr(self, "_fit_cache", None)
+        if cached is not None and all(
+            a is b for a, b in zip(cached[0], cache_key)
+        ):
+            datasets, val_ctx = cached[1]
+        else:
+            datasets = self._build_datasets(data, initial_model)
+            val_ctx = (
+                self._build_validation(datasets, validation)
+                if validation is not None
+                else None
+            )
+            self._fit_cache = (cache_key, (datasets, val_ctx))
         if opt_config_sequence is None:
             opt_config_sequence = [{}]
 

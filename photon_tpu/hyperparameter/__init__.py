@@ -1,0 +1,54 @@
+"""Hyperparameter tuning: Sobol random search + GP Bayesian search.
+
+TPU-native counterpart of photon-lib hyperparameter/* (search, estimators,
+kernels, criteria, slice sampler, rescaling — SURVEY §1 layer 9) and the
+photon-api tuner dispatch. See the individual modules for file:line parity
+citations.
+"""
+
+from photon_tpu.hyperparameter.criteria import (
+    ConfidenceBound,
+    ExpectedImprovement,
+)
+from photon_tpu.hyperparameter.evaluation import (
+    DEFAULT_REG_ALPHA_RANGE,
+    DEFAULT_REG_WEIGHT_RANGE,
+    GameEstimatorEvaluationFunction,
+)
+from photon_tpu.hyperparameter.gp import (
+    GaussianProcessEstimator,
+    GaussianProcessModel,
+)
+from photon_tpu.hyperparameter.rescaling import (
+    DoubleRange,
+    scale_backward,
+    scale_forward,
+    transform_backward,
+    transform_forward,
+)
+from photon_tpu.hyperparameter.search import (
+    GaussianProcessSearch,
+    RandomSearch,
+)
+from photon_tpu.hyperparameter.slice_sampler import SliceSampler
+from photon_tpu.hyperparameter.tuner import HyperparameterTuningMode, search
+
+__all__ = [
+    "ConfidenceBound",
+    "ExpectedImprovement",
+    "DEFAULT_REG_ALPHA_RANGE",
+    "DEFAULT_REG_WEIGHT_RANGE",
+    "GameEstimatorEvaluationFunction",
+    "GaussianProcessEstimator",
+    "GaussianProcessModel",
+    "DoubleRange",
+    "scale_backward",
+    "scale_forward",
+    "transform_backward",
+    "transform_forward",
+    "GaussianProcessSearch",
+    "RandomSearch",
+    "SliceSampler",
+    "HyperparameterTuningMode",
+    "search",
+]

@@ -1,0 +1,60 @@
+"""Acquisition criteria (prediction transformations) for Bayesian search.
+
+TPU-native counterpart of photon-lib hyperparameter/criteria/
+ExpectedImprovement.scala:58 and ConfidenceBound.scala:48, plus the
+PredictionTransformation contract (estimators/PredictionTransformation.scala).
+Each criterion is a callable (means, variances) -> scores, pure jnp so it can
+run inside the vmapped posterior-sample average.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_INV_SQRT_2 = 0.7071067811865476
+_INV_SQRT_2PI = 0.3989422804014327
+
+
+def _norm_cdf(z: Array) -> Array:
+    return 0.5 * (1.0 + jax.lax.erf(z * _INV_SQRT_2))
+
+
+def _norm_pdf(z: Array) -> Array:
+    return _INV_SQRT_2PI * jnp.exp(-0.5 * z * z)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpectedImprovement:
+    """EI against the best (lowest) observed evaluation; maximized.
+
+    Reference: ExpectedImprovement.scala:58 — gamma = -(mean - best)/std,
+    EI = std * (gamma * Phi(gamma) + phi(gamma)) (PBO eqs. 1-2). The search
+    minimizes the evaluation value, so EI is maximized.
+    """
+
+    best_evaluation: float
+    is_max_opt: bool = True
+
+    def __call__(self, means: Array, variances: Array) -> Array:
+        std = jnp.sqrt(variances)
+        gamma = -(means - self.best_evaluation) / std
+        return std * (gamma * _norm_cdf(gamma) + _norm_pdf(gamma))
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfidenceBound:
+    """Lower confidence bound mean - k*std; minimized.
+
+    Reference: ConfidenceBound.scala:48 (explorationFactor default 2.0,
+    PBO eq. 3)."""
+
+    exploration_factor: float = 2.0
+    is_max_opt: bool = False
+
+    def __call__(self, means: Array, variances: Array) -> Array:
+        return means - self.exploration_factor * jnp.sqrt(variances)

@@ -1,0 +1,208 @@
+"""Stationary covariance kernels for GP hyperparameter search — pure jnp.
+
+TPU-native counterpart of the reference's kernel classes
+(photon-lib hyperparameter/estimators/kernels/StationaryKernel.scala:189-loc,
+Matern52.scala:44, RBF.scala:34, Kernel.scala). The Scala classes carry their
+parameters as object state and loop over rows to build the Gram matrix; here a
+kernel is a (name, theta) pair and every operation is a vectorized, jittable
+function of ``theta = [amplitude, noise, length_scale...]``:
+
+- ``gram(name, theta, x)``: K = amplitude * f(d2) + noise * I
+  (StationaryKernel.apply one-matrix form, :61-70).
+- ``cross(name, theta, x1, x2)``: amplitude * f(d2), no noise (:76-87).
+- ``log_likelihood(name, theta, x, y)``: GPML Algorithm 2.1 marginal
+  likelihood via Cholesky, plus the reference's priors — lognormal on
+  amplitude, horseshoe on noise, tophat [0, 2] on each length scale
+  (StationaryKernel.logLikelihood :110-152).
+
+Rows may be padding: a ``valid`` mask turns padded rows into unit-diagonal /
+zero-coupling entries so one jitted likelihood serves a growing observation
+set without recompilation (observations are padded up to a bucket size).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# Priors (StationaryKernel.scala): lognormal amplitude scale, horseshoe
+# noise scale, tophat max for length scales.
+AMPLITUDE_SCALE = 1.0
+NOISE_SCALE = 0.1
+LENGTH_SCALE_MAX = 2.0
+
+DEFAULT_NOISE = 1e-4
+
+KERNEL_NAMES = ("matern52", "rbf")
+
+
+def _from_sq_dists(name: str, d2: Array) -> Array:
+    """Covariance from squared scaled distances (fromPairwiseDistances)."""
+    if name == "matern52":
+        f = jnp.sqrt(5.0 * d2)
+        return (1.0 + f + (5.0 / 3.0) * d2) * jnp.exp(-f)
+    if name == "rbf":
+        return jnp.exp(-0.5 * d2)
+    raise ValueError(f"unknown kernel {name!r}")
+
+
+def split_theta(theta: Array) -> tuple[Array, Array, Array]:
+    """theta -> (amplitude, noise, length_scale[d or 1])."""
+    return theta[0], theta[1], theta[2:]
+
+
+def make_theta(amplitude, noise, length_scale) -> jnp.ndarray:
+    return jnp.concatenate([
+        jnp.asarray([amplitude, noise], dtype=jnp.result_type(float)),
+        jnp.atleast_1d(jnp.asarray(length_scale, dtype=jnp.result_type(float))),
+    ])
+
+
+def initial_theta(y: Array, num_length_scales: int) -> jnp.ndarray:
+    """Matern52.getInitialKernel: amplitude = stddev(y), defaults elsewhere.
+
+    The reference keeps a single shared length scale; we carry one per
+    hyperparameter dimension (ARD), initialized to 1.0.
+    """
+    amp = jnp.std(y)
+    amp = jnp.where(amp > 0, amp, 1.0)
+    return make_theta(amp, DEFAULT_NOISE, jnp.ones(num_length_scales))
+
+
+def _sq_dists(x1: Array, x2: Array) -> Array:
+    """Pairwise squared Euclidean distances [n1, n2] (pairwiseDistances)."""
+    d2 = (
+        jnp.sum(x1 * x1, axis=1)[:, None]
+        - 2.0 * x1 @ x2.T
+        + jnp.sum(x2 * x2, axis=1)[None, :]
+    )
+    return jnp.maximum(d2, 0.0)
+
+
+def _scaled(x: Array, length_scale: Array) -> Array:
+    # A length-1 scale broadcasts across all dims (expandDimensions).
+    return x / length_scale
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def gram(name: str, theta: Array, x: Array, valid: Array | None = None) -> Array:
+    """K(x, x) with noise on the diagonal; padded rows become identity."""
+    amplitude, noise, ls = split_theta(theta)
+    xs = _scaled(x, ls)
+    k = amplitude * _from_sq_dists(name, _sq_dists(xs, xs))
+    k = k + noise * jnp.eye(x.shape[0], dtype=x.dtype)
+    if valid is not None:
+        pair = valid[:, None] * valid[None, :]
+        eye = jnp.eye(x.shape[0], dtype=x.dtype)
+        k = jnp.where(pair > 0, k, eye)
+    return k
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def cross(name: str, theta: Array, x1: Array, x2: Array,
+          valid2: Array | None = None) -> Array:
+    """K(x1, x2) without noise; padded x2 rows contribute zero coupling."""
+    amplitude, _, ls = split_theta(theta)
+    k = amplitude * _from_sq_dists(
+        name, _sq_dists(_scaled(x1, ls), _scaled(x2, ls))
+    )
+    if valid2 is not None:
+        k = k * valid2[None, :]
+    return k
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def log_likelihood(
+    name: str, theta: Array, x: Array, y: Array, valid: Array
+) -> Array:
+    """GP marginal log likelihood + hyperprior terms; -inf out of bounds.
+
+    Reference: StationaryKernel.logLikelihood :110-152 — bounds checks
+    (nonneg params, tophat length-scale max), GPML 2.1 line 7 via Cholesky,
+    lognormal amplitude prior, horseshoe noise prior; any numerical failure
+    (non-PD K) yields -inf.
+    """
+    amplitude, noise, ls = split_theta(theta)
+    n_real = jnp.sum(valid)
+    k = gram(name, theta, x, valid)
+    chol = jnp.linalg.cholesky(k)
+    ym = y * valid
+    alpha = jax.scipy.linalg.cho_solve((chol, True), ym)
+    # Padded rows have unit diagonal: their log-det contribution is 0 and
+    # alpha entries are y*0 = 0.
+    lik = (
+        -0.5 * jnp.dot(ym, alpha)
+        - jnp.sum(jnp.log(jnp.diagonal(chol)) * valid)
+        - 0.5 * n_real * jnp.log(2.0 * jnp.pi)
+    )
+    # Lognormal amplitude prior + horseshoe noise prior.
+    lik = lik - 0.5 * jnp.log(jnp.sqrt(amplitude / AMPLITUDE_SCALE)) ** 2
+    lik = lik + jnp.where(
+        noise > 0,
+        jnp.log(jnp.log1p((NOISE_SCALE / noise) ** 2)),
+        0.0,
+    )
+    in_bounds = (
+        (amplitude > 0)
+        & (noise >= 0)
+        & jnp.all(ls > 0)
+        & jnp.all(ls <= LENGTH_SCALE_MAX)
+    )
+    return jnp.where(
+        in_bounds & jnp.isfinite(lik), lik, -jnp.inf
+    )
+
+
+def log_likelihood_np(name: str, theta, x, y) -> float:
+    """Host-side scalar twin of ``log_likelihood`` for the slice sampler.
+
+    Slice sampling's step-out walk evaluates the likelihood hundreds of
+    times sequentially at tiny n; per-call device dispatch would dominate
+    by orders of magnitude (the reference's Breeze calls are in-process for
+    the same reason). Same math, numpy; tested equal to the jnp version.
+    """
+    import numpy as np
+
+    theta = np.asarray(theta, dtype=float)
+    amplitude, noise, ls = theta[0], theta[1], theta[2:]
+    if (
+        amplitude <= 0
+        or noise < 0
+        or (ls <= 0).any()
+        or (ls > LENGTH_SCALE_MAX).any()
+    ):
+        return -np.inf
+    xs = np.asarray(x, dtype=float) / ls
+    d2 = (
+        (xs * xs).sum(1)[:, None]
+        - 2.0 * xs @ xs.T
+        + (xs * xs).sum(1)[None, :]
+    )
+    d2 = np.maximum(d2, 0.0)
+    if name == "matern52":
+        f = np.sqrt(5.0 * d2)
+        k = (1.0 + f + (5.0 / 3.0) * d2) * np.exp(-f)
+    elif name == "rbf":
+        k = np.exp(-0.5 * d2)
+    else:
+        raise ValueError(f"unknown kernel {name!r}")
+    k = amplitude * k + noise * np.eye(xs.shape[0])
+    try:
+        chol = np.linalg.cholesky(k)
+    except np.linalg.LinAlgError:
+        return -np.inf
+    y = np.asarray(y, dtype=float)
+    alpha = np.linalg.solve(chol.T, np.linalg.solve(chol, y))
+    lik = (
+        -0.5 * float(y @ alpha)
+        - float(np.log(np.diagonal(chol)).sum())
+        - 0.5 * xs.shape[0] * np.log(2.0 * np.pi)
+    )
+    lik -= 0.5 * np.log(np.sqrt(amplitude / AMPLITUDE_SCALE)) ** 2
+    if noise > 0:
+        lik += np.log(np.log1p((NOISE_SCALE / noise) ** 2))
+    return lik if np.isfinite(lik) else -np.inf

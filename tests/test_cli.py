@@ -183,3 +183,39 @@ class TestScoreCLI:
         assert len(recs) == 500
         assert np.isfinite([r["predictionScore"] for r in recs]).all()
         assert (score_out / "evaluation.json").is_file()
+
+
+class TestHyperparameterTuningCLI:
+    def test_tuning_improves_over_bad_grid(self, tmp_path, glmix_avro,
+                                           capsys):
+        """runHyperparameterTuning wiring (GameTrainingDriver.scala:677-719):
+        RANDOM tuning must evaluate extra configs and the selected model
+        must be at least as good as the deliberately bad grid's best."""
+        from photon_tpu.cli.train import main
+
+        train, val = glmix_avro
+        cfg_path, _ = _config(
+            tmp_path, train, val,
+            coordinates={
+                "global": {
+                    "type": "fixed",
+                    "regularization": {
+                        "type": "L2",
+                        "weights": [1e4],  # terrible over-regularization
+                        "weight_range": [1e-4, 1e4],
+                    },
+                },
+            },
+            hyperparameter_tuning={
+                "mode": "RANDOM", "iterations": 4, "seed": 7},
+        )
+        assert main(["--config", str(cfg_path)]) == 0
+        summary = json.loads(
+            (tmp_path / "out" / "training-summary.json").read_text())
+        assert summary["num_configurations"] == 5  # 1 grid + 4 tuned
+        assert summary["num_tuned_configurations"] == 4
+        rmses = [c["evaluation"]["RMSE"]
+                 for c in summary["configurations"]]
+        # The grid model is badly over-regularized; tuning must beat it.
+        assert min(rmses[1:]) < rmses[0]
+        assert summary["best_configuration_index"] != 0
