@@ -62,6 +62,10 @@ class GLMOptimizationConfiguration:
     # means the tuner's defaults apply.
     regularization_weight_range: tuple[float, float] | None = None
     elastic_net_param_range: tuple[float, float] | None = None
+    # Incremental training: importance of the Gaussian prior built from the
+    # previous model (GLMOptimizationConfiguration incrementalWeight,
+    # DistributedGLMLossFunction.scala:190-192; default 1.0).
+    incremental_weight: float = 1.0
 
     def with_regularization_weight(self, weight: float) -> "GLMOptimizationConfiguration":
         """Warm-start lambda update
@@ -167,6 +171,10 @@ class GLMOptimizationProblem:
     normalization: NormalizationContext = dataclasses.field(
         default_factory=no_normalization)
     intercept_index: int | None = None
+    # Incremental-training Gaussian prior (previous model's means/variances
+    # in original space); replaces the plain L2 penalty when set
+    # (DistributedGLMLossFunction.scala:184-193).
+    prior: Coefficients | None = None
 
     @property
     def loss(self) -> losses_mod.PointwiseLoss:
@@ -203,6 +211,16 @@ class GLMOptimizationProblem:
 
         cfg = self.config
         use_owlqn = cfg.l1_weight != 0.0
+        prior = None
+        if self.prior is not None:
+            if self.prior.variances is None:
+                raise ValueError(
+                    "incremental training requires prior variances "
+                    "(GameEstimator.scala:241-382 invariants)")
+            prior = (
+                jnp.asarray(self.prior.means, dtype=dtype),
+                jnp.asarray(self.prior.variances, dtype=dtype),
+            )
         # Box-constraint arrays make the optimizer config unhashable; that
         # rare path runs untraced (the constraints become trace constants).
         run = _run_jit if cfg.optimizer.box_constraints is None else _run_impl
@@ -212,6 +230,8 @@ class GLMOptimizationProblem:
             jnp.asarray(cfg.l1_weight, dtype=dtype),
             jnp.asarray(cfg.l2_weight, dtype=dtype),
             self.normalization,
+            prior,
+            jnp.asarray(cfg.incremental_weight, dtype=dtype),
             task=self.task,
             opt_config=cfg.optimizer,
             use_owlqn=use_owlqn,
@@ -229,6 +249,8 @@ def _run_impl(
     l1_weight: Array,
     l2_weight: Array,
     norm: NormalizationContext,
+    prior: tuple[Array, Array] | None,
+    incremental_weight: Array,
     *,
     task: TaskType,
     opt_config: optim.OptimizerConfig,
@@ -247,14 +269,31 @@ def _run_impl(
     loss = losses_mod.get_loss(task)
     w0 = norm.coef_to_transformed_space(w0_orig)
     fun = glm_ops.make_value_and_grad(batch, loss, norm)
-    obj = optim.with_l2(fun, l2_weight, intercept_index)
+
+    if prior is not None:
+        # Gaussian prior REPLACES the plain L2 term; the L2 weight survives
+        # as the inverse-variance fallback for features absent from the
+        # prior model (PriorDistribution.scala:31-60, normalizePrior :49).
+        prior_means_t = norm.coef_to_transformed_space(prior[0])
+        inv_prior_var_t = optim.inverse_prior_variances(
+            norm.var_to_transformed_space(prior[1]), l2_weight
+        )
+        obj = optim.with_gaussian_prior(
+            fun, incremental_weight, prior_means_t, inv_prior_var_t
+        )
+    else:
+        obj = optim.with_l2(fun, l2_weight, intercept_index)
 
     if use_owlqn:
         result = optim.owlqn_solve(obj, w0, l1_weight, opt_config)
     elif opt_config.optimizer_type == optim.OptimizerType.TRON:
-        hvp = optim.with_l2_hvp(
-            glm_ops.make_hvp(batch, loss, norm), l2_weight, intercept_index
-        )
+        raw_hvp = glm_ops.make_hvp(batch, loss, norm)
+        if prior is not None:
+            hvp = optim.with_gaussian_prior_hvp(
+                raw_hvp, incremental_weight, inv_prior_var_t
+            )
+        else:
+            hvp = optim.with_l2_hvp(raw_hvp, l2_weight, intercept_index)
         result = optim.tron_solve(obj, hvp, w0, opt_config)
     else:
         result = optim.lbfgs_solve(obj, w0, opt_config)
@@ -263,9 +302,14 @@ def _run_impl(
         variances = None
     else:
         d = w0_orig.shape[-1]
-        l2_diag = jnp.full((d,), l2_weight, dtype=w0_orig.dtype)
-        if intercept_index is not None:
-            l2_diag = l2_diag.at[intercept_index].set(0.0)
+        if prior is not None:
+            # The prior contributes iw/var to every diagonal entry
+            # (PriorDistributionTwiceDiff.l2RegHessianDiagonal).
+            l2_diag = incremental_weight * inv_prior_var_t
+        else:
+            l2_diag = jnp.full((d,), l2_weight, dtype=w0_orig.dtype)
+            if intercept_index is not None:
+                l2_diag = l2_diag.at[intercept_index].set(0.0)
         variances = variances_in_transformed_space(
             batch, loss, result.coefficients, norm, l2_diag,
             variance_computation,

@@ -107,15 +107,23 @@ def _solve_one_entity(
     shifts: Array,  # [S] (zeros where none)
     intercept_slot: Array,  # scalar int32, -1 if absent
     w0_orig: Array,  # [S] original-space warm start
+    prior: tuple[Array, Array] | None,  # ([S] means, [S] vars) original space
     *,
     sub_dim: int,
     task: TaskType,
-    config: GLMOptimizationConfiguration,
+    opt_config: optim.OptimizerConfig,
+    use_owlqn: bool,
+    variance_computation: VarianceComputationType,
+    l1_weight: Array,  # traced scalars, closed over (broadcast under vmap)
+    l2_weight: Array,
+    incremental_weight: Array,
 ):
     """One entity's full solve; vmapped over the bucket's entity axis.
 
     Mirrors SingleNodeOptimizationProblem.run (:90-98): transformed-space
     solve with the effective-coefficient rewrite, reported in original space.
+    Regularization weights are traced, so a new lambda (warm-start ladder,
+    tuner retrain) reuses the compiled block solve.
     """
     loss = losses_mod.get_loss(task)
     feats = SparseFeatures(x_indices, x_values, sub_dim)
@@ -136,28 +144,41 @@ def _solve_one_entity(
 
     w0 = _coef_to_transformed(w0_orig, factors, shifts, int_onehot)
     fun = glm_ops.make_value_and_grad(batch, loss, norm)
-    l1 = config.l1_weight
-    l2 = config.l2_weight
-    obj = fun if l2 == 0.0 else optim.with_l2_masked(fun, l2, penalty_mask)
-
-    if l1 != 0.0:
-        result = optim.owlqn_solve(obj, w0, l1, config.optimizer)
-    elif config.optimizer.optimizer_type == optim.OptimizerType.TRON:
-        hvp = glm_ops.make_hvp(batch, loss, norm)
-        obj_hvp = (
-            hvp if l2 == 0.0
-            else optim.with_l2_hvp_masked(hvp, l2, penalty_mask)
-        )
-        result = optim.tron_solve(obj, obj_hvp, w0, config.optimizer)
+    if prior is not None:
+        # Per-entity Gaussian prior (incremental training): replaces the
+        # plain L2 term; the L2 weight is the fallback precision for slots
+        # absent from the prior model (PriorDistribution.scala:31-60).
+        # Padded slots are masked out of the penalty entirely.
+        prior_means_t = _coef_to_transformed(
+            prior[0], factors, shifts, int_onehot)
+        f_sq = 1.0 if factors is None else factors * factors
+        inv_prior_var = optim.inverse_prior_variances(
+            prior[1] / f_sq, l2_weight) * valid_mask
+        obj = optim.with_gaussian_prior(
+            fun, incremental_weight, prior_means_t, inv_prior_var)
+        l2_diag = incremental_weight * inv_prior_var
     else:
-        result = optim.lbfgs_solve(obj, w0, config.optimizer)
+        obj = optim.with_l2_masked(fun, l2_weight, penalty_mask)
+        l2_diag = l2_weight * penalty_mask
+
+    if use_owlqn:
+        result = optim.owlqn_solve(obj, w0, l1_weight, opt_config)
+    elif opt_config.optimizer_type == optim.OptimizerType.TRON:
+        hvp = glm_ops.make_hvp(batch, loss, norm)
+        if prior is not None:
+            obj_hvp = optim.with_gaussian_prior_hvp(
+                hvp, incremental_weight, inv_prior_var)
+        else:
+            obj_hvp = optim.with_l2_hvp_masked(hvp, l2_weight, penalty_mask)
+        result = optim.tron_solve(obj, obj_hvp, w0, opt_config)
+    else:
+        result = optim.lbfgs_solve(obj, w0, opt_config)
 
     w_t = result.coefficients * valid_mask
 
-    if config.variance_computation != VarianceComputationType.NONE:
+    if variance_computation != VarianceComputationType.NONE:
         var_t = variances_in_transformed_space(
-            batch, loss, w_t, norm, l2 * penalty_mask,
-            config.variance_computation,
+            batch, loss, w_t, norm, l2_diag, variance_computation,
         )
         f_sq = 1.0 if factors is None else factors * factors
         # Padded slots (and zero-support slots) carry var inf; report 0 for
@@ -170,21 +191,42 @@ def _solve_one_entity(
     return w_orig, variances, result.iterations, result.convergence_reason
 
 
-@functools.partial(jax.jit, static_argnames=("sub_dim", "task", "config"))
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "sub_dim", "task", "opt_config", "use_owlqn", "variance_computation",
+    ),
+)
 def _solve_block(
     block: EntityBlocks,
     offsets: Array,  # [B, R] effective offsets (base + residuals)
     factors_sub: Array,  # [B, S]
     shifts_sub: Array,  # [B, S]
     w0: Array,  # [B, S] original-space warm starts
+    l1_weight: Array,
+    l2_weight: Array,
+    incremental_weight: Array,
+    prior: tuple[Array, Array] | None,  # ([B, S], [B, S]) or None
     *,
     sub_dim: int,
     task: TaskType,
-    config: GLMOptimizationConfiguration,
+    opt_config: optim.OptimizerConfig,
+    use_owlqn: bool,
+    variance_computation: VarianceComputationType,
 ):
-    solver = functools.partial(
-        _solve_one_entity, sub_dim=sub_dim, task=task, config=config
-    )
+    def solver(xi, xv, lb, off, wt, pm, vm, f, sh, islot, w0_e, prior_e):
+        return _solve_one_entity(
+            xi, xv, lb, off, wt, pm, vm, f, sh, islot, w0_e, prior_e,
+            sub_dim=sub_dim,
+            task=task,
+            opt_config=opt_config,
+            use_owlqn=use_owlqn,
+            variance_computation=variance_computation,
+            l1_weight=l1_weight,
+            l2_weight=l2_weight,
+            incremental_weight=incremental_weight,
+        )
+
     return jax.vmap(solver)(
         block.x_indices,
         block.x_values,
@@ -197,6 +239,7 @@ def _solve_block(
         shifts_sub,
         block.intercept_slots,
         w0,
+        prior,
     )
 
 
@@ -214,6 +257,11 @@ class RandomEffectCoordinate:
     normalization: NormalizationContext = dataclasses.field(
         default_factory=NormalizationContext
     )
+    # Incremental-training prior: a RandomEffectModel (with variances)
+    # already remapped onto this dataset's entity/slot layout. Entities or
+    # slots absent from it carry variance 0 and fall back to plain L2
+    # (RandomEffectOptimizationProblem.scala:137-198 projected priors).
+    prior: RandomEffectModel | None = None
 
     def _projected_norms(self, block: EntityBlocks, dtype):
         """Gather the global factor/shift vectors through each entity's
@@ -284,15 +332,37 @@ class RandomEffectCoordinate:
                 )[:, :s]
             else:
                 w0 = jnp.zeros((block.num_entities, s), dtype)
+            prior = None
+            if self.prior is not None:
+                if self.prior.variances is None:
+                    raise ValueError(
+                        "incremental training requires prior variances for "
+                        "every entity model (GameEstimator.scala:241-382)")
+                prior = (
+                    jnp.take(
+                        self.prior.coefficients.astype(dtype),
+                        block.entity_codes, axis=0,
+                    )[:, :s],
+                    jnp.take(
+                        self.prior.variances.astype(dtype),
+                        block.entity_codes, axis=0,
+                    )[:, :s],
+                )
             w, v, it, reason = _solve_block(
                 block,
                 offsets,
                 f,
                 sh,
                 w0,
+                jnp.asarray(self.config.l1_weight, dtype=dtype),
+                jnp.asarray(self.config.l2_weight, dtype=dtype),
+                jnp.asarray(self.config.incremental_weight, dtype=dtype),
+                prior,
                 sub_dim=s,
                 task=self.task,
-                config=self.config,
+                opt_config=self.config.optimizer,
+                use_owlqn=self.config.l1_weight != 0.0,
+                variance_computation=self.config.variance_computation,
             )
             pad = ds.max_sub_dim - s
             if pad:

@@ -86,6 +86,7 @@ def parse_coordinate(cid: str, d: dict) -> CoordinateSpec:
         variance_computation=VarianceComputationType(
             d.get("variance_computation", "NONE").upper()
         ),
+        incremental_weight=float(d.get("incremental_weight", 1.0)),
     )
     reg_dict = d.get("regularization", {})
     reg, lambdas = _parse_regularization(reg_dict)
@@ -141,6 +142,7 @@ class TrainingConfig:
     warm_start_model_dir: str | None
     locked_coordinates: set[str]
     hyperparameter_tuning: dict | None
+    incremental_training: bool
 
     @staticmethod
     def load(path: str) -> "TrainingConfig":
@@ -169,6 +171,7 @@ class TrainingConfig:
             warm_start_model_dir=raw.get("warm_start_model_dir"),
             locked_coordinates=set(raw.get("locked_coordinates", ())),
             hyperparameter_tuning=raw.get("hyperparameter_tuning"),
+            incremental_training=bool(raw.get("incremental_training", False)),
         )
 
     def opt_config_sequence(self) -> list[dict[str, GLMOptimizationConfiguration]]:
@@ -193,6 +196,7 @@ class TrainingConfig:
             intercept_indices=intercept_indices or {},
             evaluators=self.evaluators or None,
             locked_coordinates=self.locked_coordinates,
+            incremental_training=self.incremental_training,
         )
 
 

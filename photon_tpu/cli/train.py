@@ -124,6 +124,10 @@ def main(argv=None) -> int:
             cfg.warm_start_model_dir, index_maps
         )
         log.info("warm start from %s", cfg.warm_start_model_dir)
+    if cfg.incremental_training and initial_model is None:
+        raise ValueError(
+            "incremental_training is enabled but no warm_start_model_dir "
+            "is configured (GameEstimator.scala:241-382)")
 
     # ------------------------------------------------------------------
     # feature stats + normalization (prepareNormalizationContexts :590)
@@ -178,6 +182,7 @@ def main(argv=None) -> int:
             hyperparameter.GameEstimatorEvaluationFunction(
                 estimator, base_config, train, validation,
                 is_opt_max=evaluator.bigger_is_better,
+                initial_model=initial_model,
             ))
         if evaluation_function.num_params == 0:
             log.warning(

@@ -141,6 +141,7 @@ class GameEstimator:
         intercept_indices: dict[str, int] | None = None,
         evaluators: list[str | EvaluatorSpec] | None = None,
         locked_coordinates: set[str] | None = None,
+        incremental_training: bool = False,
     ):
         self.task = task
         self.coordinate_configs = dict(coordinate_configs)
@@ -157,6 +158,10 @@ class GameEstimator:
         self.intercept_indices = dict(intercept_indices or {})
         self.evaluators = list(evaluators or [])
         self.locked_coordinates = set(locked_coordinates or ())
+        # Incremental training: the initial model becomes a per-coefficient
+        # Gaussian prior (GameEstimator.scala incrementalTraining param;
+        # invariants validated at fit time, :241-382).
+        self.incremental_training = incremental_training
 
     # ------------------------------------------------------------------
     # dataset / coordinate construction (prepareTrainingDatasets + factory)
@@ -184,7 +189,10 @@ class GameEstimator:
                         tag = data.id_tags[cfg.data.random_effect_type]
                         extra = {}
                         for eo, key in enumerate(prior.entity_keys):
-                            code = tag.vocab.get(key)
+                            # vocab keys are str-normalized at ingest;
+                            # models saved before normalization may carry
+                            # numeric keys.
+                            code = tag.vocab.get(str(key))
                             if code is not None:
                                 p = prior.proj_all[eo]
                                 extra[code] = p[p >= 0]
@@ -204,8 +212,12 @@ class GameEstimator:
         self,
         datasets: dict[str, object],
         opt_configs: dict[str, GLMOptimizationConfiguration],
+        priors: dict[str, object] | None = None,
     ) -> dict[str, object]:
-        """CoordinateFactory.build equivalent (CoordinateFactory.scala:52)."""
+        """CoordinateFactory.build equivalent (CoordinateFactory.scala:52);
+        ``priors`` carries incremental-training prior models per coordinate
+        (the factory's priorModelOpt, DistributedGLMLossFunction.scala:184)."""
+        priors = priors or {}
         coords: dict[str, object] = {}
         for cid, cfg in self.coordinate_configs.items():
             opt = opt_configs.get(cid, cfg.optimization)
@@ -215,6 +227,7 @@ class GameEstimator:
                     self.task,
                     opt,
                     self._shard_norm(cfg.data.feature_shard_id),
+                    prior=priors.get(cid),
                 )
             else:
                 problem = GLMOptimizationProblem(
@@ -224,6 +237,7 @@ class GameEstimator:
                     intercept_index=self.intercept_indices.get(
                         cfg.feature_shard_id
                     ),
+                    prior=priors.get(cid),
                 )
                 coords[cid] = _FixedEffectModelAdapter(
                     FixedEffectCoordinate(datasets[cid], problem),
@@ -287,6 +301,8 @@ class GameEstimator:
         (warm-start / partial-retrain model loading,
         GameTrainingDriver.scala:395-404).
         """
+        if self.incremental_training:
+            self._validate_incremental(initial_model)
         # Repeated fits on the same data (the lambda grid re-entered by the
         # hyperparameter tuner, GameEstimatorEvaluationFunction.scala:40)
         # reuse the ingested device datasets: the build is the expensive
@@ -308,10 +324,44 @@ class GameEstimator:
         if opt_config_sequence is None:
             opt_config_sequence = [{}]
 
+        # Externally loaded RE models carry their own entity vocab / slot
+        # layout; remap each ONCE onto this dataset's layout — the result
+        # serves both the config-0 warm start and the incremental prior.
+        if initial_model is not None:
+            for cid in self.update_sequence:
+                if cid not in initial_model:
+                    continue
+                m = initial_model[cid]
+                if isinstance(m, RandomEffectModel):
+                    ds = datasets[cid]
+                    if (m.entity_keys is not ds.entity_keys
+                            or m.proj_all is not ds.proj_all):
+                        initial_model = initial_model.updated(
+                            cid,
+                            remap_random_effect_model(
+                                m,
+                                entity_keys=ds.entity_keys,
+                                proj_all=ds.proj_all,
+                            ),
+                        )
+
+        # Incremental training: the ORIGINAL initial model (not the previous
+        # config's result) becomes the Gaussian prior for every config.
+        priors: dict[str, object] = {}
+        if self.incremental_training:
+            for cid in self.update_sequence:
+                if cid in self.locked_coordinates:
+                    continue
+                m = initial_model[cid]
+                if isinstance(m, RandomEffectModel):
+                    priors[cid] = m
+                else:
+                    priors[cid] = m.model.coefficients
+
         results: list[GameFitResult] = []
         prev_model: GameModel | None = initial_model
         for i, opt_configs in enumerate(opt_config_sequence):
-            coords = self._build_coordinates(datasets, opt_configs)
+            coords = self._build_coordinates(datasets, opt_configs, priors)
             cd = CoordinateDescent(
                 self.update_sequence,
                 self.num_iterations,
@@ -359,6 +409,63 @@ class GameEstimator:
             ))
             prev_model = descent.model
         return results
+
+    def _validate_incremental(self, initial_model: GameModel | None) -> None:
+        """Incremental-training invariants (GameEstimator.validateParams
+        :241-382): an initial model must cover every trained coordinate with
+        matching shard / random-effect type and carry variances."""
+        if initial_model is None:
+            raise ValueError(
+                "incremental training is enabled but no initial model "
+                "provided")
+        to_train = [
+            cid for cid in self.update_sequence
+            if cid not in self.locked_coordinates
+        ]
+        missing = [cid for cid in to_train if cid not in initial_model]
+        if missing:
+            raise ValueError(
+                "coordinate sets don't match for incremental training; "
+                f"missing coordinates: {', '.join(missing)}")
+        for cid in to_train:
+            cfg = self.coordinate_configs[cid]
+            m = initial_model[cid]
+            if isinstance(cfg, RandomEffectCoordinateConfiguration):
+                if not isinstance(m, RandomEffectModel):
+                    raise ValueError(
+                        f"incremental training error: coordinate {cid!r} is "
+                        "random-effect but the initial model is not")
+                if m.feature_shard_id != cfg.data.feature_shard_id:
+                    raise ValueError(
+                        f"incremental training error: feature shard ID "
+                        f"mismatch for coordinate {cid!r} "
+                        f"({cfg.data.feature_shard_id!r} vs. "
+                        f"{m.feature_shard_id!r})")
+                if m.random_effect_type != cfg.data.random_effect_type:
+                    raise ValueError(
+                        f"incremental training error: random effect type "
+                        f"mismatch for coordinate {cid!r} "
+                        f"({cfg.data.random_effect_type!r} vs. "
+                        f"{m.random_effect_type!r})")
+                if m.variances is None:
+                    raise ValueError(
+                        f"incremental training error: coordinate {cid!r} "
+                        "missing variance information")
+            else:
+                if isinstance(m, RandomEffectModel):
+                    raise ValueError(
+                        f"incremental training error: coordinate {cid!r} is "
+                        "fixed-effect but the initial model is random-effect")
+                if m.feature_shard_id != cfg.feature_shard_id:
+                    raise ValueError(
+                        f"incremental training error: feature shard ID "
+                        f"mismatch for coordinate {cid!r} "
+                        f"({cfg.feature_shard_id!r} vs. "
+                        f"{m.feature_shard_id!r})")
+                if m.model.coefficients.variances is None:
+                    raise ValueError(
+                        f"incremental training error: coordinate {cid!r} "
+                        "missing variance information")
 
     def select_best(self, results: list[GameFitResult]) -> GameFitResult:
         """Best config by validation primary metric (selectBestModel,

@@ -63,6 +63,9 @@ class GameEstimatorEvaluationFunction:
     data: object  # GameDataset
     validation_data: object  # GameDataset
     is_opt_max: bool
+    # Warm-start / incremental-training model, forwarded into every retrain
+    # (required when the estimator has incremental_training enabled).
+    initial_model: object | None = None
 
     def __post_init__(self):
         self._coordinate_ids = sorted(self.base_config)
@@ -100,7 +103,8 @@ class GameEstimatorEvaluationFunction:
         scaled = scale_backward(candidate, self.ranges)
         config = self.vector_to_configuration(scaled)
         result = self.estimator.fit(
-            self.data, self.validation_data, [config]
+            self.data, self.validation_data, [config],
+            initial_model=self.initial_model,
         )[0]
         direction = -1.0 if self.is_opt_max else 1.0
         return direction * result.evaluation.primary_evaluation, result

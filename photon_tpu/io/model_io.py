@@ -112,6 +112,17 @@ SCORING_RESULT_SCHEMA = {
 }
 
 
+def _resolve_index(index_map: IndexMap, name: str, term: str) -> int | None:
+    """Inverse of the save-side split_feature_key: keys WITHOUT a delimiter
+    serialize as (name, term="") (types.py split_feature_key), so an empty
+    term must also try the bare name — identity index maps ("0", "1", ...)
+    would otherwise silently drop every feature on load."""
+    idx = index_map.get_index(make_feature_key(name, term))
+    if idx is None and term == "":
+        idx = index_map.get_index(name)
+    return idx
+
+
 def _ntv_list(values: np.ndarray, indices, index_map: IndexMap,
               sparsity_threshold: float) -> list[dict]:
     out = []
@@ -156,16 +167,14 @@ def _record_to_coefficients(
 ) -> tuple[Coefficients, TaskType | None]:
     means = np.zeros(dim)
     for ntv in rec["means"]:
-        idx = index_map.get_index(make_feature_key(ntv["name"], ntv["term"]))
+        idx = _resolve_index(index_map, ntv["name"], ntv["term"])
         if idx is not None:
             means[idx] = ntv["value"]
     variances = None
     if rec.get("variances"):
         variances = np.zeros(dim)
         for ntv in rec["variances"]:
-            idx = index_map.get_index(
-                make_feature_key(ntv["name"], ntv["term"])
-            )
+            idx = _resolve_index(index_map, ntv["name"], ntv["term"])
             if idx is not None:
                 variances[idx] = ntv["value"]
     task = _CLASS_TO_TASK.get(rec.get("modelClass") or "")
@@ -302,17 +311,13 @@ def load_game_model(
                 entity_ids.append(rec["modelId"])
                 mmap: dict[int, float] = {}
                 for ntv in rec["means"]:
-                    idx = imap.get_index(
-                        make_feature_key(ntv["name"], ntv["term"])
-                    )
+                    idx = _resolve_index(imap, ntv["name"], ntv["term"])
                     if idx is not None:
                         mmap[idx] = ntv["value"]
                 vmap: dict[int, float] = {}
                 if rec.get("variances"):
                     for ntv in rec["variances"]:
-                        idx = imap.get_index(
-                            make_feature_key(ntv["name"], ntv["term"])
-                        )
+                        idx = _resolve_index(imap, ntv["name"], ntv["term"])
                         if idx is not None:
                             vmap[idx] = ntv["value"]
                     any_var = True

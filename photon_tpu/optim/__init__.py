@@ -24,6 +24,9 @@ from photon_tpu.optim.owlqn import owlqn_solve
 from photon_tpu.optim.regularization import (
     RegularizationContext,
     RegularizationType,
+    inverse_prior_variances,
+    with_gaussian_prior,
+    with_gaussian_prior_hvp,
     with_l2,
     with_l2_hvp,
     with_l2_hvp_masked,
@@ -47,6 +50,9 @@ __all__ = [
     "owlqn_solve",
     "solve",
     "tron_solve",
+    "inverse_prior_variances",
+    "with_gaussian_prior",
+    "with_gaussian_prior_hvp",
     "with_l2",
     "with_l2_hvp",
     "with_l2_hvp_masked",

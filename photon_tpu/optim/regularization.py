@@ -151,3 +151,64 @@ def with_l2_hvp(
         return hvp(w, d) + l2_weight * _l2_mask(d, intercept_index)
 
     return wrapped
+
+
+# MathConst.EPSILON (photon-lib constants/MathConst.scala:21): variances at
+# or below this magnitude mean "feature absent from the prior model".
+PRIOR_VARIANCE_EPSILON = 1e-12
+
+
+def inverse_prior_variances(prior_variances: Array, l2_weight) -> Array:
+    """1/variance with the l2 fallback for absent features.
+
+    Reference: PriorDistribution.inversePriorVariances via
+    VectorUtils.invertVectorWithZeroHandler (util/VectorUtils.scala:298-299):
+    features not in the prior model carry variance 0 and fall back to the
+    plain L2 weight.
+    """
+    return jnp.where(
+        jnp.abs(prior_variances) > PRIOR_VARIANCE_EPSILON,
+        1.0 / prior_variances,
+        l2_weight,
+    )
+
+
+def with_gaussian_prior(
+    fun: ValueAndGrad,
+    incremental_weight,
+    prior_means: Array,
+    inv_prior_variances: Array,
+) -> ValueAndGrad:
+    """Add the incremental-training Gaussian prior penalty.
+
+    Reference: PriorDistribution.l2RegValue / PriorDistributionDiff
+    .l2RegGradient (function/PriorDistribution.scala:31-137):
+      value += iw/2 * sum((w - m)^2 / var),  grad += iw * (w - m) / var,
+    in the transformed space (``prior_means`` / ``inv_prior_variances`` are
+    already transformed via normalizePrior :49-60). Unlike plain L2, the
+    intercept is NOT excluded — the prior model constrains it too.
+    """
+
+    def wrapped(w: Array):
+        f, g = fun(w)
+        dw = (w - prior_means) * inv_prior_variances
+        val = 0.5 * incremental_weight * jnp.dot(w - prior_means, dw)
+        return f + val, g + incremental_weight * dw
+
+    return wrapped
+
+
+def with_gaussian_prior_hvp(
+    hvp: HessianVectorProduct,
+    incremental_weight,
+    inv_prior_variances: Array,
+) -> HessianVectorProduct:
+    """Prior term's Hessian contribution iw * d / var.
+
+    Reference: PriorDistributionTwiceDiff.l2RegHessianVector
+    (function/PriorDistribution.scala:141-186)."""
+
+    def wrapped(w: Array, d: Array):
+        return hvp(w, d) + incremental_weight * (d * inv_prior_variances)
+
+    return wrapped
