@@ -143,6 +143,7 @@ class TrainingConfig:
     locked_coordinates: set[str]
     hyperparameter_tuning: dict | None
     incremental_training: bool
+    data_validation: str
 
     @staticmethod
     def load(path: str) -> "TrainingConfig":
@@ -172,6 +173,8 @@ class TrainingConfig:
             locked_coordinates=set(raw.get("locked_coordinates", ())),
             hyperparameter_tuning=raw.get("hyperparameter_tuning"),
             incremental_training=bool(raw.get("incremental_training", False)),
+            data_validation=str(
+                raw.get("data_validation", "DISABLED")).upper(),
         )
 
     def opt_config_sequence(self) -> list[dict[str, GLMOptimizationConfiguration]]:

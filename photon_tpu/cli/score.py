@@ -34,6 +34,8 @@ def main(argv=None) -> int:
     parser.add_argument("--evaluators", nargs="*", default=None,
                         help="optional metrics, e.g. AUC RMSE AUC:userId")
     parser.add_argument("--id-tags", nargs="*", default=None)
+    parser.add_argument("--data-validation", default="DISABLED",
+                        help="FULL | SAMPLE | DISABLED")
     parser.add_argument("--backend", default=None)
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
@@ -76,6 +78,13 @@ def main(argv=None) -> int:
         "features": index_map}
     model, metadata = load_game_model(args.model_dir, index_maps)
 
+    from photon_tpu.data.validators import sanity_check_data
+
+    # Scoring rows may carry dummy labels; validate everything else
+    # (before shard aliasing so the single table is scanned once).
+    sanity_check_data(
+        data, model.task, args.data_validation, check_labels=False,
+    )
     data = _alias_shards(data, needed_shards)
     transformer = GameTransformer(model)
     scores, evaluation = transformer.transform(

@@ -107,6 +107,15 @@ def main(argv=None) -> int:
     log.info("read %d train rows (%d features)",
              train.num_samples, len(index_map))
 
+    # ------------------------------------------------------------------
+    # data validation (DataValidators.sanityCheckDataFrameForTraining :433)
+    # ------------------------------------------------------------------
+    from photon_tpu.data.validators import sanity_check_data
+
+    sanity_check_data(train, cfg.task, cfg.data_validation)
+    if validation is not None:
+        sanity_check_data(validation, cfg.task, cfg.data_validation)
+
     shards = sorted(train.feature_shards)
     index_maps = {s: index_map for s in shards}
     intercept_indices = {}

@@ -89,6 +89,11 @@ def score_entity_table(
     w: Array, codes: Array, indices: Array, values: Array
 ) -> Array:
     """z_i = sum_j values[i,j] * w[codes[i], indices[i,j]] (jit-friendly)."""
+    if w.shape[0] == 0:
+        # Empty model set (e.g. a partial-retrain dir with no coefficients):
+        # every row is an unknown entity and scores 0 (the reference's
+        # left-join-with-no-match semantics).
+        return jnp.zeros(codes.shape[0], dtype=values.dtype)
     rows = jnp.take(w, codes, axis=0)  # [n, S]
     picked = jnp.take_along_axis(rows, indices, axis=-1)  # [n, k]
     return jnp.sum(values * picked, axis=-1)

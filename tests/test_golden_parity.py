@@ -1,0 +1,289 @@
+"""Golden-data parity: the reference's OWN fixtures through our stack.
+
+The reference freezes end-to-end metrics against an "assumed-correct
+implementation" (GameTrainingDriverIntegTest.scala:78-79 RMSE < 1.697 on
+Yahoo! Music) and ships real Avro fixtures under
+photon-client/src/integTest/resources. These tests consume those exact files
+(read-only from /root/reference) to prove:
+
+- our from-scratch Avro codec reads reference-written containers;
+- the CLI trains on the reference's datasets (heart.avro, a9a) above frozen
+  metric thresholds (frozen 2026-07-30 from an assumed-correct run of this
+  framework, the reference's own discipline);
+- ``load_game_model`` loads a GAME model directory the reference wrote
+  (GameIntegTest/retrainModels/mixedEffects), proving format parity against
+  files this repo did not produce.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REF = "/root/reference/photon-client/src/integTest/resources"
+HEART = f"{REF}/DriverIntegTest/input/heart.avro"
+A9A = f"{REF}/DriverIntegTest/input/a9a"
+A9A_TEST = f"{REF}/DriverIntegTest/input/a9a.t"
+MIXED_MODEL = f"{REF}/GameIntegTest/retrainModels/mixedEffects"
+FE_ONLY_MODEL = f"{REF}/GameIntegTest/fixedEffectOnlyGAMEModel"
+YAHOO = f"{REF}/GameIntegTest/input/duplicateFeatures/yahoo-music-train.avro"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference fixtures not mounted"
+)
+
+
+class TestReferenceAvroReads:
+    def test_heart_reads_with_our_codec(self):
+        from photon_tpu.io import avro
+
+        recs = avro.read_container_dir(HEART)
+        assert len(recs) == 250
+        labels = {r["label"] for r in recs}
+        assert labels == {0, 1}
+        # 13 features per row, named "1".."13" with empty terms.
+        assert len(recs[0]["features"]) == 13
+
+    def test_heart_into_game_dataset(self):
+        from photon_tpu.io.avro_data import read_training_examples
+
+        data, imap = read_training_examples(HEART)
+        assert data.num_samples == 250
+        # 13 features + intercept.
+        assert len(imap) == 14
+        assert imap.intercept_index is not None
+
+    def test_yahoo_music_multi_shard_ingest(self):
+        """The Yahoo! Music schema (global features + per-user/per-song
+        shards + id columns) assembles into a GLMix-ready GameDataset."""
+        from photon_tpu.data.dataset import rows_to_ell, SparseFeatures
+        from photon_tpu.data.game_data import make_game_dataset
+        from photon_tpu.data.index_map import IndexMap
+        from photon_tpu.io import avro
+        from photon_tpu.types import make_feature_key
+
+        recs = avro.read_container_dir(YAHOO)
+        assert len(recs) > 0
+
+        def shard_rows(field):
+            keys = sorted({
+                make_feature_key(f["name"], f["term"])
+                for r in recs for f in r[field]
+            })
+            imap = IndexMap({k: i for i, k in enumerate(keys)})
+            rows = [
+                [(imap.get_index(make_feature_key(f["name"], f["term"])),
+                  f["value"]) for f in r[field]]
+                for r in recs
+            ]
+            idx, val = rows_to_ell(rows, len(imap))
+            return SparseFeatures(jnp.asarray(idx), jnp.asarray(val),
+                                  len(imap))
+
+        data = make_game_dataset(
+            [r["response"] for r in recs],
+            {
+                "global": shard_rows("features"),
+                "userShard": shard_rows("userFeatures"),
+                "songShard": shard_rows("songFeatures"),
+            },
+            id_tags={
+                "userId": np.asarray([r["userId"] for r in recs]),
+                "songId": np.asarray([r["songId"] for r in recs]),
+            },
+            dtype=jnp.float64,
+        )
+        assert data.num_samples == len(recs)
+        assert data.id_tags["userId"].num_groups >= 1
+
+
+class TestReferenceModelLoad:
+    def _index_maps_from_model(self, model_dir):
+        """Index maps built from the model's own feature names (the
+        reference resolves them through the training feature maps)."""
+        from photon_tpu.data.index_map import IndexMap
+        from photon_tpu.io import avro
+        from photon_tpu.types import make_feature_key
+
+        shard_keys: dict[str, set] = {}
+        for kind in ("fixed-effect", "random-effect"):
+            d = os.path.join(model_dir, kind)
+            if not os.path.isdir(d):
+                continue
+            for name in sorted(os.listdir(d)):
+                shard = open(
+                    os.path.join(d, name, "id-info")
+                ).read().strip().splitlines()[-1]
+                coef_dir = os.path.join(d, name, "coefficients")
+                if not os.path.isdir(coef_dir):
+                    continue
+                keys = shard_keys.setdefault(shard, set())
+                for rec in avro.read_container_dir(coef_dir):
+                    for ntv in rec["means"] + (rec.get("variances") or []):
+                        keys.add(make_feature_key(ntv["name"], ntv["term"]))
+        return {
+            shard: IndexMap({k: i for i, k in enumerate(sorted(keys))})
+            for shard, keys in shard_keys.items()
+        }
+
+    def test_load_reference_mixed_effects_model(self):
+        """A GAME model dir written by the REFERENCE (Spark) loads: fixed
+        effect + two random-effect coordinates with thousands of per-entity
+        models."""
+        from photon_tpu.io import avro
+        from photon_tpu.io.model_io import load_game_model
+        from photon_tpu.models.game import RandomEffectModel
+        from photon_tpu.types import INTERCEPT_KEY, make_feature_key
+
+        imaps = self._index_maps_from_model(MIXED_MODEL)
+        model, metadata = load_game_model(MIXED_MODEL, imaps)
+        assert metadata["modelType"] == "LINEAR_REGRESSION"
+        # per-user ships id-info but no coefficients (partial-retrain
+        # fixture) and loads as an empty model set.
+        assert set(model.models) == {
+            "global", "per-song", "per-artist", "per-user"}
+        assert model["per-user"].num_entities == 0
+
+        # Fixed effect: spot-check the intercept against the raw record.
+        fe = model["global"]
+        rec = avro.read_container_dir(
+            os.path.join(MIXED_MODEL, "fixed-effect/global/coefficients")
+        )[0]
+        raw = {
+            make_feature_key(n["name"], n["term"]): n["value"]
+            for n in rec["means"]
+        }
+        imap = imaps[fe.feature_shard_id]
+        w = np.asarray(fe.model.coefficients.means)
+        for key, value in raw.items():
+            assert w[imap.get_index(key)] == pytest.approx(value)
+        assert INTERCEPT_KEY in raw  # reference writes "(INTERCEPT)"
+
+        # Random effects: every per-entity record reassembled.
+        per_song = model["per-song"]
+        assert isinstance(per_song, RandomEffectModel)
+        song_recs = avro.read_container_dir(
+            os.path.join(MIXED_MODEL, "random-effect/per-song/coefficients")
+        )
+        assert per_song.num_entities == len(song_recs)
+        assert per_song.random_effect_type == "songId"
+        # Spot-check one entity's coefficient by (key, feature).
+        rec = song_recs[0]
+        vocab = {k: i for i, k in enumerate(per_song.entity_keys)}
+        e = vocab[rec["modelId"]]
+        imap_s = imaps[per_song.feature_shard_id]
+        for ntv in rec["means"][:5]:
+            fidx = imap_s.get_index(make_feature_key(ntv["name"],
+                                                     ntv["term"]))
+            slot = np.nonzero(per_song.proj_all[e] == fidx)[0]
+            assert slot.size == 1
+            assert float(per_song.coefficients[e, slot[0]]) == pytest.approx(
+                ntv["value"])
+
+    def test_loaded_reference_model_scores(self):
+        """The loaded reference model must score data (the format parity is
+        functional, not just structural)."""
+        from photon_tpu.data.dataset import DenseFeatures
+        from photon_tpu.data.game_data import make_game_dataset
+        from photon_tpu.io.model_io import load_game_model
+        from photon_tpu.transformers import GameTransformer
+
+        imaps = self._index_maps_from_model(FE_ONLY_MODEL)
+        model, _ = load_game_model(FE_ONLY_MODEL, imaps)
+        (shard,) = imaps
+        d = len(imaps[shard])
+        rng = np.random.default_rng(0)
+        data = make_game_dataset(
+            np.zeros(8),
+            {shard: DenseFeatures(jnp.asarray(rng.normal(size=(8, d))))},
+            dtype=jnp.float64,
+        )
+        scores = np.asarray(GameTransformer(model).score(data))
+        assert scores.shape == (8,)
+        assert np.abs(scores).max() > 0  # nonzero coefficients engaged
+
+
+class TestGoldenMetrics:
+    """Frozen-threshold e2e metrics on the reference's datasets (the
+    RMSE < 1.697 discipline, GameTrainingDriverIntegTest.scala:78-79).
+    Thresholds frozen 2026-07-30 from an assumed-correct run."""
+
+    def test_heart_cli_auc(self, tmp_path, capsys):
+        from photon_tpu.cli.train import main
+
+        cfg = {
+            "task": "LOGISTIC_REGRESSION",
+            "input": {"format": "avro", "train_path": HEART,
+                      "validation_path": HEART},
+            "coordinates": {
+                "global": {
+                    "type": "fixed",
+                    "regularization": {"type": "L2", "weights": [1.0]},
+                },
+            },
+            "normalization": "STANDARDIZATION",
+            "evaluators": ["AUC"],
+            "data_validation": "FULL",
+            "output_dir": str(tmp_path / "out"),
+        }
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps(cfg))
+        assert main(["--config", str(p)]) == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        # UCI heart train AUC; frozen threshold.
+        assert out["evaluation"]["AUC"] > 0.90
+
+    def test_a9a_cli_auc(self, tmp_path, capsys):
+        from photon_tpu.cli.train import main
+
+        cfg = {
+            "task": "LOGISTIC_REGRESSION",
+            "input": {"format": "libsvm", "train_path": A9A,
+                      "validation_path": A9A_TEST},
+            "coordinates": {
+                "global": {
+                    "type": "fixed",
+                    "regularization": {"type": "L2", "weights": [1.0]},
+                },
+            },
+            "evaluators": ["AUC"],
+            "output_dir": str(tmp_path / "out"),
+        }
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps(cfg))
+        assert main(["--config", str(p)]) == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        # a9a held-out AUC for L2 logistic regression; frozen threshold
+        # (published linear-model results sit at ~0.90).
+        assert out["evaluation"]["AUC"] > 0.895
+
+
+class TestEmptyRandomEffectScores:
+    def test_partial_retrain_model_scores_zero_for_empty_coordinate(self):
+        """The mixedEffects fixture's per-user coordinate has no
+        coefficients; scoring through it must contribute 0, not crash."""
+        from photon_tpu.data.random_effect import remap_for_scoring
+        from photon_tpu.data.dataset import DenseFeatures
+        from photon_tpu.data.game_data import make_game_dataset
+        from photon_tpu.io.model_io import load_game_model
+
+        loader = TestReferenceModelLoad()
+        imaps = loader._index_maps_from_model(MIXED_MODEL)
+        model, _ = load_game_model(MIXED_MODEL, imaps)
+        pu = model["per-user"]
+        assert pu.num_entities == 0
+        data = make_game_dataset(
+            np.zeros(5),
+            {pu.feature_shard_id: DenseFeatures(jnp.ones((5, 2)))},
+            id_tags={"userId": np.arange(5)},
+            dtype=jnp.float64,
+        )
+        codes, si, sv = remap_for_scoring(
+            data, re_type="userId",
+            feature_shard_id=pu.feature_shard_id,
+            entity_keys=pu.entity_keys, proj_all=pu.proj_all,
+        )
+        scores = np.asarray(pu.score_table(codes, si, sv))
+        np.testing.assert_array_equal(scores, np.zeros(5))

@@ -1,0 +1,148 @@
+"""DataValidators: row-level sanity checks gated by validation mode.
+
+Mirrors photon-client data/DataValidators.scala:405 — per-task validator
+stacks (finite features/offsets, positive weights, task-dependent labels)
+and FULL/SAMPLE/DISABLED gating, raising one error naming every failed
+check.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import DenseFeatures, rows_to_ell, SparseFeatures
+from photon_tpu.data.game_data import make_game_dataset
+from photon_tpu.data.validators import (
+    DataValidationType,
+    sanity_check_data,
+)
+from photon_tpu.types import TaskType
+
+
+def _data(labels, x=None, offsets=None, weights=None):
+    labels = np.asarray(labels, dtype=float)
+    n = labels.shape[0]
+    if x is None:
+        x = np.ones((n, 2))
+    return make_game_dataset(
+        labels,
+        {"features": DenseFeatures(jnp.asarray(np.asarray(x, dtype=float)))},
+        offsets=offsets,
+        weights=weights,
+        dtype=jnp.float64,
+    )
+
+
+class TestValidators:
+    def test_clean_data_passes_all_tasks(self, rng):
+        d = _data(np.abs(rng.normal(size=20)))
+        for task in (TaskType.LINEAR_REGRESSION, TaskType.POISSON_REGRESSION):
+            sanity_check_data(d, task, "FULL")
+        d_bin = _data(rng.integers(0, 2, size=20))
+        sanity_check_data(d_bin, TaskType.LOGISTIC_REGRESSION, "FULL")
+        sanity_check_data(
+            d_bin, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM, "FULL")
+
+    def test_nan_label_rejected_for_linear(self):
+        d = _data([1.0, np.nan, 2.0])
+        with pytest.raises(ValueError, match=r"NaN\) label.*1 row"):
+            sanity_check_data(d, TaskType.LINEAR_REGRESSION, "FULL")
+
+    def test_nonbinary_label_rejected_for_logistic(self):
+        d = _data([0.0, 1.0, 0.5])
+        with pytest.raises(ValueError, match="non-binary label"):
+            sanity_check_data(d, TaskType.LOGISTIC_REGRESSION, "FULL")
+
+    def test_negative_label_rejected_for_poisson(self):
+        d = _data([1.0, -2.0, 0.0])
+        with pytest.raises(ValueError, match=r"invalid \(-, Inf"):
+            sanity_check_data(d, TaskType.POISSON_REGRESSION, "FULL")
+        # The same labels are fine for linear regression.
+        sanity_check_data(d, TaskType.LINEAR_REGRESSION, "FULL")
+
+    def test_infinite_feature_rejected_and_named(self):
+        x = np.ones((3, 2))
+        x[1, 0] = np.inf
+        d = _data([1.0, 2.0, 3.0], x=x)
+        with pytest.raises(ValueError, match=r"feature\(s\): features"):
+            sanity_check_data(d, TaskType.LINEAR_REGRESSION, "FULL")
+
+    def test_sparse_features_checked(self):
+        idx, val = rows_to_ell([[(0, 1.0)], [(1, np.nan)]], 2)
+        d = make_game_dataset(
+            [0.0, 1.0],
+            {"features": SparseFeatures(jnp.asarray(idx), jnp.asarray(val), 2)},
+            dtype=jnp.float64,
+        )
+        with pytest.raises(ValueError, match="feature"):
+            sanity_check_data(d, TaskType.LINEAR_REGRESSION, "FULL")
+
+    def test_bad_offset_and_weight_collected_together(self):
+        d = _data(
+            [1.0, 2.0], offsets=[np.inf, 0.0], weights=[1.0, 0.0])
+        with pytest.raises(ValueError) as e:
+            sanity_check_data(d, TaskType.LINEAR_REGRESSION, "FULL")
+        msg = str(e.value)
+        assert "offset(s)" in msg and "weight(s)" in msg
+
+    def test_zero_weight_rejected(self):
+        d = _data([1.0], weights=[0.0])
+        with pytest.raises(ValueError, match="weight"):
+            sanity_check_data(d, TaskType.LINEAR_REGRESSION, "FULL")
+
+    def test_disabled_skips_everything(self):
+        d = _data([np.nan], weights=[-1.0])
+        sanity_check_data(d, TaskType.LINEAR_REGRESSION, "DISABLED")
+        sanity_check_data(
+            d, TaskType.LINEAR_REGRESSION,
+            DataValidationType.VALIDATE_DISABLED)
+
+    def test_sample_mode_checks_subset(self):
+        """SAMPLE checks ~10%: all-bad data must still fail; the check must
+        not read every row (deterministic seed)."""
+        labels = np.full(100, np.nan)
+        d = _data(labels)
+        with pytest.raises(ValueError, match="label"):
+            sanity_check_data(d, TaskType.LINEAR_REGRESSION, "SAMPLE")
+
+    def test_check_labels_false_for_scoring(self):
+        d = _data([np.nan, np.inf])
+        sanity_check_data(
+            d, TaskType.LINEAR_REGRESSION, "FULL", check_labels=False)
+
+    def test_mode_parsing(self):
+        assert (DataValidationType.parse("full")
+                == DataValidationType.VALIDATE_FULL)
+        assert (DataValidationType.parse("VALIDATE_SAMPLE")
+                == DataValidationType.VALIDATE_SAMPLE)
+        with pytest.raises(ValueError):
+            DataValidationType.parse("bogus")
+
+
+class TestCLIValidation:
+    def test_train_cli_rejects_bad_rows(self, tmp_path, rng):
+        from photon_tpu.cli.train import main
+        from photon_tpu.io.avro_data import write_training_examples
+        from photon_tpu.types import DELIMITER
+        import json
+
+        n, d = 30, 3
+        keys = [f"f{i}{DELIMITER}t" for i in range(d)]
+        x = rng.normal(size=(n, d))
+        y = rng.normal(size=n)
+        y[7] = np.nan  # poison one row
+        rows = [[(keys[j], float(x[i, j])) for j in range(d)]
+                for i in range(n)]
+        p = tmp_path / "bad.avro"
+        write_training_examples(str(p), y, rows)
+        cfg = {
+            "task": "LINEAR_REGRESSION",
+            "input": {"format": "avro", "train_path": str(p)},
+            "coordinates": {"global": {"type": "fixed"}},
+            "output_dir": str(tmp_path / "out"),
+            "data_validation": "FULL",
+        }
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text(json.dumps(cfg))
+        with pytest.raises(ValueError, match="Data Validation failed"):
+            main(["--config", str(cfg_path)])
