@@ -95,6 +95,96 @@ def _coef_to_original(w_t, factors, shifts, int_onehot):
     return w
 
 
+def _solve_one_entity_direct(
+    x_indices: Array,  # [R, k]
+    x_values: Array,  # [R, k]
+    labels: Array,  # [R]
+    offsets: Array,  # [R]
+    weights: Array,  # [R]
+    penalty_mask: Array,  # [S]
+    valid_mask: Array,  # [S]
+    factors: Array | None,  # [S]
+    shifts: Array | None,  # [S]
+    intercept_slot: Array,
+    prior: tuple[Array, Array] | None,
+    *,
+    sub_dim: int,
+    variance_computation: VarianceComputationType,
+    l2_weight: Array,
+    incremental_weight: Array,
+    task: TaskType,
+):
+    """Exact per-entity solve for the squared-loss case: one batched
+    Cholesky instead of ~100 sequential L-BFGS device steps.
+
+    The per-entity GLMix subproblem for squared loss is a small convex
+    quadratic; its minimizer is the normal-equations solution
+      (X'^T diag(wt) X' + diag(pen)) w = X'^T diag(wt) (y - offset) (+ prior)
+    — identical (to machine precision) to what the reference's LBFGS/TRON
+    iterates toward (SingleNodeOptimizationProblem.run), but as a single
+    MXU-friendly [S, S] factorization per entity, vmapped over the bucket.
+    The subspace design matrix is densified per entity (S = sub_dim is small
+    by construction — LinearSubspaceProjector compression).
+    """
+    dtype = x_values.dtype
+    r = x_values.shape[0]
+    rows = jnp.broadcast_to(jnp.arange(r)[:, None], x_indices.shape)
+    x = jnp.zeros((r, sub_dim), dtype).at[rows, x_indices].add(x_values)
+    if shifts is not None:
+        x = x - shifts[None, :]
+    if factors is not None:
+        x = x * factors[None, :]
+    y_eff = (labels - offsets) * weights
+    h = x.T @ (x * weights[:, None])
+    b = x.T @ y_eff
+    if prior is not None:
+        int_onehot = (
+            None if shifts is None
+            else _onehot(intercept_slot, sub_dim, dtype)
+        )
+        m_t = _coef_to_transformed(prior[0], factors, shifts, int_onehot)
+        f_sq = 1.0 if factors is None else factors * factors
+        inv_prior_var = optim.inverse_prior_variances(
+            prior[1] / f_sq, l2_weight) * valid_mask
+        l2_diag = incremental_weight * inv_prior_var
+        b = b + l2_diag * m_t
+    else:
+        l2_diag = l2_weight * penalty_mask
+    h = h + jnp.diag(l2_diag + (1.0 - valid_mask))
+    chol = jnp.linalg.cholesky(h)
+    w_t = jax.scipy.linalg.cho_solve((chol, True), b) * valid_mask
+
+    norm = NormalizationContext(
+        factors=factors, shifts=shifts,
+        intercept_index=None if shifts is None else 0,
+    )
+    if variance_computation != VarianceComputationType.NONE:
+        loss = losses_mod.get_loss(task)
+        batch = GLMBatch(
+            SparseFeatures(x_indices, x_values, sub_dim),
+            labels, offsets, weights,
+        )
+        var_t = variances_in_transformed_space(
+            batch, loss, w_t, norm, l2_diag, variance_computation,
+        )
+        f_sq = 1.0 if factors is None else factors * factors
+        variances = jnp.where(valid_mask > 0, var_t * f_sq, 0.0)
+    else:
+        variances = jnp.zeros_like(w_t)
+
+    int_onehot = (
+        None if shifts is None else _onehot(intercept_slot, sub_dim, dtype)
+    )
+    w_orig = _coef_to_original(w_t, factors, shifts, int_onehot) * valid_mask
+    return (
+        w_orig,
+        variances,
+        jnp.asarray(1, jnp.int32),
+        jnp.asarray(int(optim.ConvergenceReason.GRADIENT_CONVERGED),
+                    jnp.int32),
+    )
+
+
 def _solve_one_entity(
     x_indices: Array,  # [R, k]
     x_values: Array,  # [R, k]
@@ -195,6 +285,7 @@ def _solve_one_entity(
     jax.jit,
     static_argnames=(
         "sub_dim", "task", "opt_config", "use_owlqn", "variance_computation",
+        "direct",
     ),
 )
 def _solve_block(
@@ -213,7 +304,33 @@ def _solve_block(
     opt_config: optim.OptimizerConfig,
     use_owlqn: bool,
     variance_computation: VarianceComputationType,
+    direct: bool = False,
 ):
+    if direct:
+        def direct_solver(xi, xv, lb, off, wt, pm, vm, f, sh, islot, prior_e):
+            return _solve_one_entity_direct(
+                xi, xv, lb, off, wt, pm, vm, f, sh, islot, prior_e,
+                sub_dim=sub_dim,
+                variance_computation=variance_computation,
+                l2_weight=l2_weight,
+                incremental_weight=incremental_weight,
+                task=task,
+            )
+
+        return jax.vmap(direct_solver)(
+            block.x_indices,
+            block.x_values,
+            block.labels,
+            offsets,
+            block.weights,
+            block.penalty_mask,
+            block.valid_mask,
+            factors_sub,
+            shifts_sub,
+            block.intercept_slots,
+            prior,
+        )
+
     def solver(xi, xv, lb, off, wt, pm, vm, f, sh, islot, w0_e, prior_e):
         return _solve_one_entity(
             xi, xv, lb, off, wt, pm, vm, f, sh, islot, w0_e, prior_e,
@@ -294,8 +411,10 @@ class RandomEffectCoordinate:
             if self.config.variance_computation != VarianceComputationType.NONE
             else None
         )
-        reasons: list[np.ndarray] = []
-        iters: list[np.ndarray] = []
+        # (device reason array, host real-entity mask) per block; fetched in
+        # two coalesced transfers after all blocks are dispatched.
+        reasons: list[tuple[Array, np.ndarray]] = []
+        iters: list[Array] = []
         real_masks = [ds.real_entity_mask(b) for b in ds.blocks]
 
         if self.normalization.shifts is not None:
@@ -348,6 +467,19 @@ class RandomEffectCoordinate:
                         block.entity_codes, axis=0,
                     )[:, :s],
                 )
+            # Squared-loss subproblems are convex quadratics: solve them
+            # exactly with one batched Cholesky instead of iterating
+            # (identical optimum, ~100x fewer sequential device steps).
+            # l2 > 0 guarantees X^T W X + diag(pen) is positive definite even
+            # for entities with fewer rows than active features — without it
+            # the normal equations can be singular and the iterative solver's
+            # implicit regularization is the correct behavior.
+            direct = (
+                self.task == TaskType.LINEAR_REGRESSION
+                and self.config.l1_weight == 0.0
+                and self.config.l2_weight > 0.0
+                and self.config.optimizer.box_constraints is None
+            )
             w, v, it, reason = _solve_block(
                 block,
                 offsets,
@@ -363,6 +495,7 @@ class RandomEffectCoordinate:
                 opt_config=self.config.optimizer,
                 use_owlqn=self.config.l1_weight != 0.0,
                 variance_computation=self.config.variance_computation,
+                direct=direct,
             )
             pad = ds.max_sub_dim - s
             if pad:
@@ -371,8 +504,10 @@ class RandomEffectCoordinate:
             w_all = w_all.at[block.entity_codes].set(w)
             if v_all is not None:
                 v_all = v_all.at[block.entity_codes].set(v)
-            reasons.append(np.asarray(reason)[real])
-            iters.append(np.asarray(it)[real])
+            # Keep diagnostics on device; fetch once after the loop
+            # (a per-block np.asarray would sync per block).
+            reasons.append((reason, real))
+            iters.append(it)
 
         model = RandomEffectModel(
             coefficients=w_all,
@@ -383,10 +518,16 @@ class RandomEffectCoordinate:
             variances=v_all,
             entity_keys=ds.entity_keys,
         )
-        stats = RandomEffectTrainingStats.from_arrays(
-            np.concatenate(reasons) if reasons else np.empty(0, np.int32),
-            np.concatenate(iters) if iters else np.empty(0, np.int32),
-        )
+        if reasons:
+            all_reasons = np.asarray(
+                jnp.concatenate([r for r, _ in reasons]))
+            all_iters = np.asarray(jnp.concatenate(iters))
+            keep = np.concatenate([real for _, real in reasons])
+            stats = RandomEffectTrainingStats.from_arrays(
+                all_reasons[keep], all_iters[keep])
+        else:
+            stats = RandomEffectTrainingStats.from_arrays(
+                np.empty(0, np.int32), np.empty(0, np.int32))
         return model, stats
 
     def score(self, model: RandomEffectModel) -> Array:

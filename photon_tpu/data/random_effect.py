@@ -337,7 +337,7 @@ def build_random_effect_dataset(
     if dtype is None:
         dtype = game_data.labels.dtype
     tag = game_data.id_tags[config.random_effect_type]
-    codes = np.asarray(tag.codes)
+    codes = np.asarray(tag.codes).astype(np.int64, copy=False)
     num_entities = tag.num_groups
     n = codes.shape[0]
 
@@ -378,21 +378,41 @@ def build_random_effect_dataset(
         active[e] = rows.size >= (lower or 1)
 
     # --- 2. per-entity subspace projectors --------------------------------
+    # Vectorized: one global unique over (entity, feature) pairs replaces
+    # the per-entity np.unique loop (generateLinearSubspaceProjectors'
+    # foldByKey becomes a single sort).
     projs: list[np.ndarray] = [np.empty(0, dtype=np.int64)] * num_entities
     sub_dims = np.zeros(num_entities, dtype=np.int64)
-    for e in range(num_entities):
-        if not active[e]:
-            continue
-        rows = entity_rows[e]
-        vals = ell_val[rows]
-        idxs = ell_idx[rows]
-        act = np.unique(idxs[vals != 0.0])
-        ratio = config.features_to_samples_ratio
+    active_ids = np.nonzero(active)[0]
+    if active_ids.size:
+        kept_rows = np.concatenate([entity_rows[e] for e in active_ids])
+        kept_codes = np.repeat(
+            active_ids, [entity_rows[e].size for e in active_ids]
+        )
+        iv = ell_idx[kept_rows]
+        present = ell_val[kept_rows] != 0.0
+        pair_codes = np.broadcast_to(kept_codes[:, None], iv.shape)[present]
+        pair_keys = (
+            pair_codes.astype(np.int64) * num_features
+            + iv[present].astype(np.int64)
+        )
+        uniq = np.unique(pair_keys)
+        e_of = uniq // num_features
+        f_of = uniq % num_features
+        e_starts = np.searchsorted(e_of, np.arange(num_entities))
+        e_ends = np.searchsorted(e_of, np.arange(num_entities), side="right")
+        for e in active_ids:
+            projs[e] = f_of[e_starts[e]:e_ends[e]]  # sorted by feature id
+
+    ratio = config.features_to_samples_ratio
+    for e in active_ids:
+        act = projs[e]
         if ratio is not None:
+            rows = entity_rows[e]
             keep = max(int(ratio * rows.size), 1)
             act = _pearson_select(
-                vals, idxs, labels_np[rows], act, keep, intercept_index,
-                num_features,
+                ell_val[rows], ell_idx[rows], labels_np[rows], act, keep,
+                intercept_index, num_features,
             )
         # Prior-model support is unioned AFTER the Pearson filter: features a
         # warm-start model depends on must stay in the subspace even when

@@ -285,6 +285,35 @@ class GameEstimator:
     # fit (GameEstimator.scala:397)
     # ------------------------------------------------------------------
 
+    def prepare(
+        self,
+        data: GameDataset,
+        validation: GameDataset | None = None,
+        initial_model: GameModel | None = None,
+    ):
+        """Build (or reuse) the per-coordinate device datasets for ``data``.
+
+        Repeated fits on the same objects (the lambda grid re-entered by the
+        hyperparameter tuner, GameEstimatorEvaluationFunction.scala:40) reuse
+        the ingested datasets: the build is the expensive host-side step and
+        is pure in (data, initial_model, validation). Call explicitly to
+        separate ingest from training (the driver's Timed sections around
+        prepareTrainingDatasets)."""
+        cache_key = (data, initial_model, validation)
+        cached = getattr(self, "_fit_cache", None)
+        if cached is not None and all(
+            a is b for a, b in zip(cached[0], cache_key)
+        ):
+            return cached[1]
+        datasets = self._build_datasets(data, initial_model)
+        val_ctx = (
+            self._build_validation(datasets, validation)
+            if validation is not None
+            else None
+        )
+        self._fit_cache = (cache_key, (datasets, val_ctx))
+        return datasets, val_ctx
+
     def fit(
         self,
         data: GameDataset,
@@ -303,24 +332,9 @@ class GameEstimator:
         """
         if self.incremental_training:
             self._validate_incremental(initial_model)
-        # Repeated fits on the same data (the lambda grid re-entered by the
-        # hyperparameter tuner, GameEstimatorEvaluationFunction.scala:40)
-        # reuse the ingested device datasets: the build is the expensive
-        # host-side step and is pure in (data, initial_model).
-        cache_key = (data, initial_model, validation)
-        cached = getattr(self, "_fit_cache", None)
-        if cached is not None and all(
-            a is b for a, b in zip(cached[0], cache_key)
-        ):
-            datasets, val_ctx = cached[1]
-        else:
-            datasets = self._build_datasets(data, initial_model)
-            val_ctx = (
-                self._build_validation(datasets, validation)
-                if validation is not None
-                else None
-            )
-            self._fit_cache = (cache_key, (datasets, val_ctx))
+        datasets, val_ctx = self.prepare(
+            data, validation=validation, initial_model=initial_model
+        )
         if opt_config_sequence is None:
             opt_config_sequence = [{}]
 

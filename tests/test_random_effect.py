@@ -176,20 +176,31 @@ class TestRandomEffectCoordinate:
         y = np.asarray(game.labels)
         codes = np.asarray(game.id_tags["userId"].codes)
         problem = GLMOptimizationProblem(task, conf, intercept_index=5)
+        linear = task == TaskType.LINEAR_REGRESSION
         for e in range(ds.num_entities):
             rows = np.nonzero(codes == e)[0]
-            batch = make_dense_batch(
-                x[rows], y[rows], dtype=jnp.float64
-            )
-            ref = problem.run(batch).model.coefficients.means
+            if linear:
+                # Linear blocks use the exact direct solver; compare against
+                # the exact optimum (the iterative reference only reaches
+                # its own stopping tolerance).
+                pen = np.full(6, 0.5)
+                pen[5] = 0.0
+                xe = x[rows]
+                ref = np.linalg.solve(
+                    xe.T @ xe + np.diag(pen), xe.T @ y[rows])
+                tol = dict(rtol=1e-8, atol=1e-9)
+            else:
+                batch = make_dense_batch(
+                    x[rows], y[rows], dtype=jnp.float64
+                )
+                ref = problem.run(batch).model.coefficients.means
+                tol = dict(rtol=2e-4, atol=2e-5)
             # Map the subspace solution back to full space.
             got = np.zeros(6)
             for s, f in enumerate(ds.proj_all[e]):
                 if f >= 0:
                     got[f] = float(model.coefficients[e, s])
-            np.testing.assert_allclose(
-                got, np.asarray(ref), rtol=2e-4, atol=2e-5
-            )
+            np.testing.assert_allclose(got, np.asarray(ref), **tol)
 
     def test_residuals_shift_solution(self, rng):
         game, _ = _toy_game_dataset(rng, n=100, num_entities=4)
@@ -289,3 +300,68 @@ class TestBucketCapRounding:
         # 9000/9100/9200 -> one shared 16384 bucket; 20000 -> 32768.
         assert caps == [16384, 32768]
         assert ds.blocks[0].num_entities + ds.blocks[1].num_entities == 4
+
+
+class TestDirectSolver:
+    def test_direct_solution_satisfies_normal_equations(self, rng):
+        """Squared-loss blocks solve exactly: w = (X'WX' + pen)^-1 X'W y_eff
+        to near machine precision (the iterative path only reaches its
+        stopping tolerance)."""
+        game, _ = _toy_game_dataset(rng, n=160, d=6, num_entities=5)
+        cfg = RandomEffectDataConfiguration("userId", "shard")
+        ds = build_random_effect_dataset(game, cfg, intercept_index=5)
+        conf = GLMOptimizationConfiguration(
+            regularization=optim.RegularizationContext(
+                optim.RegularizationType.L2),
+            regularization_weight=0.7,
+        )
+        coord = RandomEffectCoordinate(ds, TaskType.LINEAR_REGRESSION, conf)
+        model, stats = coord.train()
+        # Every entity converged in one step.
+        assert stats.iterations_max == 1
+        assert set(stats.convergence_reason_counts) == {"GRADIENT_CONVERGED"}
+
+        x = np.asarray(game.feature_shards["shard"].x)
+        y = np.asarray(game.labels)
+        codes = np.asarray(game.id_tags["userId"].codes)
+        for e in range(ds.num_entities):
+            rows = np.nonzero(codes == e)[0]
+            act = ds.proj_all[e][ds.proj_all[e] >= 0]
+            xe = x[rows][:, act]
+            pen = np.full(act.size, 0.7)
+            pen[act == 5] = 0.0  # intercept unpenalized
+            w_exact = np.linalg.solve(
+                xe.T @ xe + np.diag(pen), xe.T @ y[rows])
+            got = np.asarray(model.coefficients[e, : act.size])
+            np.testing.assert_allclose(got, w_exact, rtol=1e-9, atol=1e-10)
+
+    def test_logistic_still_uses_iterative_path(self, rng):
+        game, _ = _toy_game_dataset(
+            rng, n=200, d=6, num_entities=4, task="logistic")
+        cfg = RandomEffectDataConfiguration("userId", "shard")
+        ds = build_random_effect_dataset(game, cfg, intercept_index=5)
+        coord = RandomEffectCoordinate(
+            ds, TaskType.LOGISTIC_REGRESSION, GLMOptimizationConfiguration())
+        model, stats = coord.train()
+        # Iterative solves report real iteration counts (> 1 somewhere).
+        assert stats.iterations_max > 1
+
+
+def test_direct_solver_skipped_without_l2(rng):
+    """lambda == 0 must route to the iterative solver: the normal equations
+    can be singular for entities with fewer rows than features (review
+    regression: Cholesky NaN reported as converged)."""
+    n, d, E = 30, 6, 12  # ~2.5 rows/entity << d
+    x = rng.normal(size=(n, d))
+    game = make_game_dataset(
+        rng.normal(size=n),
+        {"shard": DenseFeatures(jnp.asarray(x))},
+        id_tags={"userId": rng.integers(0, E, size=n)},
+        dtype=jnp.float64,
+    )
+    ds = build_random_effect_dataset(
+        game, RandomEffectDataConfiguration("userId", "shard"))
+    coord = RandomEffectCoordinate(
+        ds, TaskType.LINEAR_REGRESSION, GLMOptimizationConfiguration())
+    model, stats = coord.train()
+    assert np.isfinite(np.asarray(model.coefficients)).all()
