@@ -138,12 +138,14 @@ class TrainingConfig:
     id_tags: list[str] | None
     normalization: NormalizationType
     evaluators: list[str]
-    model_output_mode: str  # ALL | BEST
+    model_output_mode: str  # NONE | BEST | EXPLICIT | TUNED | ALL
     warm_start_model_dir: str | None
     locked_coordinates: set[str]
     hyperparameter_tuning: dict | None
     incremental_training: bool
     data_validation: str
+    feature_index_dir: str | None
+    profile_dir: str | None
 
     @staticmethod
     def load(path: str) -> "TrainingConfig":
@@ -175,6 +177,8 @@ class TrainingConfig:
             incremental_training=bool(raw.get("incremental_training", False)),
             data_validation=str(
                 raw.get("data_validation", "DISABLED")).upper(),
+            feature_index_dir=raw.get("input", {}).get("feature_index_dir"),
+            profile_dir=raw.get("profile_dir"),
         )
 
     def opt_config_sequence(self) -> list[dict[str, GLMOptimizationConfiguration]]:

@@ -1,0 +1,129 @@
+"""``photon index``: build per-shard feature index maps + name-term lists.
+
+TPU-native counterpart of the two vocab-builder CLIs:
+- FeatureIndexingDriver (photon-client index/FeatureIndexingDriver.scala:42):
+  scans input Avro data and builds one name->index store per feature shard
+  (partitioned PalDB there; a JSON index map here — SURVEY §2.2 notes the
+  off-heap gymnastics are unnecessary without the JVM).
+- NameAndTermFeatureBagsDriver (data/avro/NameAndTermFeatureBagsDriver.scala
+  :32): extracts the distinct (name, term) set per feature bag to text files
+  (the ``feature-lists`` whitelist format: one "name<TAB>term" per line).
+
+A shard unions one or more feature-bag record fields
+(FeatureShardConfiguration.featureBags): ``--shards global=features`` or
+``--shards user=userFeatures,features``. Outputs per shard:
+``<out>/<shard>.index.json`` (IndexMap.save) and ``<out>/<shard>`` (the
+whitelist, named like the reference's feature-lists files).
+
+Usage:
+    python -m photon_tpu.cli.index --input data.avro --output vocab/ \
+        [--shards global=features user=userFeatures] [--no-intercept]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+
+def parse_shard_spec(specs: list[str] | None) -> dict[str, list[str]]:
+    """["global=features", "user=userFeatures,features"] -> shard -> bags."""
+    if not specs:
+        return {"features": ["features"]}
+    out: dict[str, list[str]] = {}
+    for spec in specs:
+        if "=" not in spec:
+            raise ValueError(
+                f"bad shard spec {spec!r}; expected shard=bag[,bag...]")
+        shard, bags = spec.split("=", 1)
+        out[shard.strip()] = [b.strip() for b in bags.split(",") if b.strip()]
+    return out
+
+
+def build_shard_vocabularies(
+    records: list[dict], shard_bags: dict[str, list[str]]
+) -> dict[str, list[tuple[str, str]]]:
+    """Distinct (name, term) pairs per shard, sorted — the NameAndTerm set
+    (NameAndTermFeatureBagsDriver semantics over in-memory records)."""
+    out: dict[str, list[tuple[str, str]]] = {}
+    for shard, bags in shard_bags.items():
+        seen: set[tuple[str, str]] = set()
+        for rec in records:
+            for bag in bags:
+                for ntv in rec.get(bag) or ():
+                    seen.add((ntv["name"], ntv["term"]))
+        out[shard] = sorted(seen)
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon index", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--input", required=True, nargs="+",
+                        help="Avro data files/dirs to scan")
+    parser.add_argument("--output", required=True,
+                        help="output directory for index maps + whitelists")
+    parser.add_argument("--shards", nargs="*", default=None,
+                        help="shard=bag[,bag...] specs; default "
+                             "'features=features'")
+    parser.add_argument("--no-intercept", action="store_true",
+                        help="do not reserve an intercept slot")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING)
+    log = logging.getLogger("photon.index")
+
+    from photon_tpu.data.index_map import IndexMap
+    from photon_tpu.io import avro
+    from photon_tpu.types import make_feature_key
+
+    shard_bags = parse_shard_spec(args.shards)
+    records: list[dict] = []
+    for path in args.input:
+        records.extend(avro.read_container_dir(path))
+    if not records:
+        raise ValueError(f"no records in {args.input}")
+
+    vocabularies = build_shard_vocabularies(records, shard_bags)
+    os.makedirs(args.output, exist_ok=True)
+    summary = {}
+    for shard, pairs in vocabularies.items():
+        imap = IndexMap.from_feature_names(
+            [make_feature_key(n, t) for n, t in pairs],
+            add_intercept=not args.no_intercept,
+        )
+        imap.save(os.path.join(args.output, f"{shard}.index.json"))
+        # Reference feature-lists format: "name<TAB>term" per line.
+        with open(os.path.join(args.output, shard), "w") as f:
+            for n, t in pairs:
+                f.write(f"{n}\t{t}\n")
+        summary[shard] = len(imap)
+        log.info("shard %s: %d features", shard, len(imap))
+    print(json.dumps({"output": args.output, "shards": summary}))
+    return 0
+
+
+def load_index_maps(directory: str) -> dict[str, "object"]:
+    """Load every ``<shard>.index.json`` under a ``photon index`` output dir
+    (the train/score-side counterpart of PalDBIndexMapLoader)."""
+    from photon_tpu.data.index_map import IndexMap
+
+    out = {}
+    for name in sorted(os.listdir(directory)):
+        if name.endswith(".index.json"):
+            out[name[: -len(".index.json")]] = IndexMap.load(
+                os.path.join(directory, name)
+            )
+    if not out:
+        raise ValueError(f"no *.index.json files under {directory}")
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())

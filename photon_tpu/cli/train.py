@@ -56,6 +56,7 @@ def main(argv=None) -> int:
         build_normalization_context,
     )
     from photon_tpu.stat import FeatureDataStatistics
+    from photon_tpu.utils import Timed, profile_trace
 
     t_start = time.time()
     cfg = TrainingConfig.load(args.config)
@@ -84,9 +85,34 @@ def main(argv=None) -> int:
         )
         return game, imap
 
+    prebuilt_maps = None
+    if cfg.feature_index_dir:
+        # Prebuilt vocab from `photon index` (the FeatureIndexingDriver /
+        # PalDBIndexMapLoader path): features absent from it are dropped at
+        # ingest, exactly like the reference's fixed feature maps.
+        from photon_tpu.cli.index import load_index_maps
+
+        prebuilt_maps = load_index_maps(cfg.feature_index_dir)
+        log.info("loaded %d feature index map(s) from %s",
+                 len(prebuilt_maps), cfg.feature_index_dir)
+
+    prebuilt_features_map = None
+    if prebuilt_maps is not None:
+        # Avro ingest reads the single 'features' bag; any other shard name
+        # in the vocab dir cannot be consumed here and silently training on
+        # the wrong vocabulary would be worse than failing.
+        if "features" not in prebuilt_maps:
+            raise ValueError(
+                f"feature_index_dir {cfg.feature_index_dir!r} has no "
+                f"'features' index (found: {sorted(prebuilt_maps)}); "
+                "training ingest reads the 'features' bag")
+        prebuilt_features_map = prebuilt_maps["features"]
+
     if cfg.input_format == "avro":
         train, index_map = read_training_examples(
-            cfg.train_path, id_tag_names=cfg.id_tags
+            cfg.train_path,
+            index_map=prebuilt_features_map,
+            id_tag_names=cfg.id_tags,
         )
         validation = None
         if cfg.validation_path:
@@ -168,9 +194,12 @@ def main(argv=None) -> int:
     estimator = cfg.build_estimator(norm_contexts, intercept_indices)
     opt_seq = cfg.opt_config_sequence()
     log.info("training %d configuration(s)", len(opt_seq))
-    results = estimator.fit(
-        train, validation, opt_seq, initial_model=initial_model
-    )
+    with Timed("prepare training datasets", log):
+        estimator.prepare(train, validation, initial_model)
+    with Timed("train models", log), profile_trace(cfg.profile_dir):
+        results = estimator.fit(
+            train, validation, opt_seq, initial_model=initial_model
+        )
 
     # ------------------------------------------------------------------
     # hyperparameter tuning (runHyperparameterTuning :677-719)
@@ -247,13 +276,31 @@ def main(argv=None) -> int:
     with open(os.path.join(cfg.output_dir, "training-summary.json"), "w") as f:
         json.dump(summary, f, indent=2)
 
-    to_save = (
-        [(best_idx, best)] if cfg.model_output_mode == "BEST"
-        else list(enumerate(results))
-    )
+    # Model output modes (io/ModelOutputMode.scala:47): NONE saves nothing;
+    # BEST the selected model; EXPLICIT adds the lambda-grid models; TUNED
+    # adds the tuner's models; ALL saves everything. The best model always
+    # lands in "best/".
+    num_grid = len(results) - num_tuned
+    mode = cfg.model_output_mode
+    if mode == "NONE":
+        to_save = []
+    elif mode == "BEST":
+        to_save = [(best_idx, best)]
+    elif mode == "EXPLICIT":
+        to_save = [(best_idx, best)] + [
+            (i, r) for i, r in enumerate(results[:num_grid]) if i != best_idx
+        ]
+    elif mode == "TUNED":
+        to_save = [(best_idx, best)] + [
+            (i, r) for i, r in list(enumerate(results))[num_grid:]
+            if i != best_idx
+        ]
+    elif mode == "ALL":
+        to_save = list(enumerate(results))
+    else:
+        raise ValueError(f"unknown model_output_mode {mode!r}")
     for i, r in to_save:
-        subdir = ("best" if cfg.model_output_mode == "BEST"
-                  and i == best_idx else f"config_{i}")
+        subdir = "best" if r is best else f"config_{i}"
         out = os.path.join(cfg.output_dir, "models", subdir)
         save_game_model(
             r.model, out, index_maps,
@@ -263,6 +310,44 @@ def main(argv=None) -> int:
         save_checkpoint(r.model, os.path.join(out, "checkpoint.npz"))
     log.info("saved %d model(s) to %s", len(to_save),
              os.path.join(cfg.output_dir, "models"))
+
+    # ------------------------------------------------------------------
+    # per-group evaluation output (savePerGroupEvaluationToHDFS :878-901)
+    # ------------------------------------------------------------------
+    grouped_specs = [e for e in cfg.evaluators if ":" in e]
+    if mode != "NONE" and validation is not None and grouped_specs:
+        import numpy as np
+
+        from photon_tpu.evaluation.suite import make_suite
+        from photon_tpu.transformers import GameTransformer
+
+        group_ids = {
+            name: (tag.codes, tag.num_groups)
+            for name, tag in validation.id_tags.items()
+        }
+        suite = make_suite(
+            grouped_specs, validation.labels,
+            offsets=validation.offsets, weights=validation.weights,
+            group_ids=group_ids, dtype=validation.labels.dtype,
+        )
+        for i, r in to_save:
+            scores = GameTransformer(r.model).score(validation)
+            per_group = suite.evaluate_per_group(scores)
+            out_dir = os.path.join(
+                cfg.output_dir, "group-evaluation", str(i))
+            os.makedirs(out_dir, exist_ok=True)
+            for metric, values in per_group.items():
+                tag = metric.split(":", 1)[1]
+                keys = validation.id_tags[tag].inverse
+                payload = {
+                    str(k): float(v)
+                    for k, v in zip(keys, values)
+                    if np.isfinite(v)
+                }
+                fname = metric.replace(":", "_") + ".json"
+                with open(os.path.join(out_dir, fname), "w") as f:
+                    json.dump(payload, f, indent=2)
+        log.info("wrote per-group evaluations for %d model(s)", len(to_save))
     print(json.dumps({
         "best_configuration": config_json(best),
         "evaluation":

@@ -197,18 +197,52 @@ def _segment_auc(s, y, w, gid, num_groups):
     return raw / denom  # NaN or inf where a class is absent — filtered upstream
 
 
+def grouped_auc_per_group(
+    scores, labels, group_ids, num_groups, weights=None
+) -> tuple[Array, Array]:
+    """(per-group AUC [G], validity mask [G]): single-class groups invalid.
+
+    Reference: the per-group values MultiEvaluator computes before its mean
+    (MultiEvaluator.scala:50-65) — also what the driver's per-group
+    evaluation output writes (GameTrainingDriver.scala:878-901).
+    """
+    w = jnp.ones_like(scores) if weights is None else weights
+    s, y, w, g = _grouped_sort(scores, labels, w, group_ids)
+    per_group = _segment_auc(s, y, w, g, num_groups)
+    return per_group, jnp.isfinite(per_group)
+
+
 def grouped_auc(scores, labels, group_ids, num_groups, weights=None) -> Array:
     """Mean per-group AUC, skipping single-class groups.
 
     Reference: AreaUnderROCCurveMultiEvaluator via MultiEvaluator.evaluate
     (MultiEvaluator.scala:50-65, NaN/Inf filtered before the mean).
     """
-    w = jnp.ones_like(scores) if weights is None else weights
-    s, y, w, g = _grouped_sort(scores, labels, w, group_ids)
-    per_group = _segment_auc(s, y, w, g, num_groups)
-    finite = jnp.isfinite(per_group)
+    per_group, finite = grouped_auc_per_group(
+        scores, labels, group_ids, num_groups, weights)
     return jnp.sum(jnp.where(finite, per_group, 0.0)) / jnp.maximum(
         jnp.sum(finite), 1)
+
+
+def grouped_precision_at_k_per_group(
+    scores, labels, group_ids, num_groups, k: int
+) -> tuple[Array, Array]:
+    """(per-group precision@k [G], presence mask [G])."""
+    order = jnp.lexsort((-scores, group_ids))
+    g = group_ids[order]
+    y = labels[order]
+    # rank within group = position - group start position
+    n = scores.shape[0]
+    pos = jnp.arange(n)
+    start = jax.ops.segment_min(pos, g, num_segments=num_groups)
+    rank = pos - start[g]
+    hit = (rank < k) & (y > _POS)
+    hits_per_group = jax.ops.segment_sum(
+        hit.astype(scores.dtype), g, num_segments=num_groups)
+    # Guard for group ids with no rows (possible when num_groups over-counts).
+    group_sizes = jax.ops.segment_sum(
+        jnp.ones_like(scores), g, num_segments=num_groups)
+    return hits_per_group / k, group_sizes > 0
 
 
 def grouped_precision_at_k(
@@ -219,22 +253,8 @@ def grouped_precision_at_k(
     Reference: PrecisionAtKMultiEvaluator + PrecisionAtKLocalEvaluator.
     Groups always produce a finite value, so no filtering applies.
     """
-    order = jnp.lexsort((-scores, group_ids))
-    g = group_ids[order]
-    y = labels[order]
-    # rank within group = position - group start position
-    n = scores.shape[0]
-    pos = jnp.arange(n)
-    is_start = jnp.concatenate([jnp.ones(1, dtype=bool), g[1:] != g[:-1]])
-    # group start position propagated: segment_min over positions
-    start = jax.ops.segment_min(pos, g, num_segments=num_groups)
-    rank = pos - start[g]
-    hit = (rank < k) & (y > _POS)
-    hits_per_group = jax.ops.segment_sum(hit.astype(scores.dtype), g, num_segments=num_groups)
-    # Guard for group ids with no rows (possible when num_groups over-counts).
-    group_sizes = jax.ops.segment_sum(jnp.ones_like(scores), g, num_segments=num_groups)
-    per_group = hits_per_group / k
-    present = group_sizes > 0
+    per_group, present = grouped_precision_at_k_per_group(
+        scores, labels, group_ids, num_groups, k)
     return jnp.sum(jnp.where(present, per_group, 0.0)) / jnp.maximum(
         jnp.sum(present), 1)
 

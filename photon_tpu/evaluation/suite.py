@@ -19,7 +19,9 @@ from photon_tpu.evaluation.evaluators import (
     EvaluatorSpec,
     evaluate_single,
     grouped_auc,
+    grouped_auc_per_group,
     grouped_precision_at_k,
+    grouped_precision_at_k_per_group,
 )
 
 Array = jax.Array
@@ -92,6 +94,36 @@ class EvaluationSuite:
                                       self.weights)
             out[spec.name] = float(val)
         return EvaluationResults(out, self.primary)
+
+    def evaluate_per_group(self, scores: Array) -> dict[str, np.ndarray]:
+        """Per-group metric values for every grouped evaluator.
+
+        Returns metric name -> [num_groups] float array with NaN for groups
+        the metric is undefined on (single-class AUC groups) — the values
+        behind the driver's per-group evaluation output
+        (GameTrainingDriver.savePerGroupEvaluationToHDFS :878-901).
+        """
+        z = scores + self.offsets
+        out: dict[str, np.ndarray] = {}
+        for spec in self.specs:
+            if spec.group_tag is None:
+                continue
+            codes, num_groups = self.group_ids[spec.group_tag]
+            if spec.precision_k is not None:
+                vals, valid = grouped_precision_at_k_per_group(
+                    z, self.labels, codes, num_groups, spec.precision_k)
+            else:
+                assert spec.evaluator_type is not None
+                if spec.evaluator_type.value != "AUC":
+                    raise NotImplementedError(
+                        f"grouped {spec.evaluator_type} not supported "
+                        "(reference MultiEvaluator supports AUC and "
+                        "precision@k)")
+                vals, valid = grouped_auc_per_group(
+                    z, self.labels, codes, num_groups, self.weights)
+            out[spec.name] = np.where(
+                np.asarray(valid), np.asarray(vals), np.nan)
+        return out
 
 
 def make_suite(

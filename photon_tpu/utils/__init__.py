@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: section timing + device profiling hooks."""
+
+from photon_tpu.utils.timed import Timed, profile_trace
+
+__all__ = ["Timed", "profile_trace"]

@@ -1,0 +1,55 @@
+"""Section timing + optional device profiling.
+
+TPU-native counterpart of photon-lib util/Timed.scala:33 — the
+``Timed("msg"){block}`` wall-clock section logger used pervasively by the
+reference's drivers and estimator — plus a ``jax.profiler.trace`` wrapper for
+real device traces (the capability the reference delegates to the Spark UI).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+logger = logging.getLogger("photon_tpu.timed")
+
+
+class Timed:
+    """Context manager: log begin/end + duration of a named section.
+
+    Reference: Timed.measureDuration (util/Timed.scala:53-80) — logs
+    "<msg>: begin execution" then "<msg>: executed in <t> s". The elapsed
+    time is exposed as ``.seconds`` for programmatic use (the reference's
+    OptimizationStatesTracker timing role).
+    """
+
+    def __init__(self, msg: str, log: logging.Logger | None = None):
+        self.msg = msg
+        self.log = log or logger
+        self.seconds = 0.0
+
+    def __enter__(self) -> "Timed":
+        self.log.info("%s: begin execution", self.msg)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.seconds = time.perf_counter() - self._t0
+        self.log.info("%s: executed in %.3f s", self.msg, self.seconds)
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: str | None):
+    """Wrap a block in ``jax.profiler.trace`` when a directory is given.
+
+    Produces a TensorBoard-loadable device trace; a None directory is a
+    no-op so call sites can wire it unconditionally.
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

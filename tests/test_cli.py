@@ -118,8 +118,9 @@ class TestTrainCLI:
                 for c in summary["configurations"]]
         assert lams == [1000.0, 0.01]
         assert summary["best_configuration_index"] == 1
+        # The best model always lands in best/; the rest keep config_<i>.
         assert (tmp_path / "out" / "models" / "config_0").is_dir()
-        assert (tmp_path / "out" / "models" / "config_1").is_dir()
+        assert (tmp_path / "out" / "models" / "best").is_dir()
 
     def test_libsvm_input(self, tmp_path, rng, capsys):
         from photon_tpu.cli.train import main
@@ -219,3 +220,184 @@ class TestHyperparameterTuningCLI:
         # The grid model is badly over-regularized; tuning must beat it.
         assert min(rmses[1:]) < rmses[0]
         assert summary["best_configuration_index"] != 0
+
+
+class TestIndexCLI:
+    def test_build_index_and_whitelists(self, tmp_path, glmix_avro, capsys):
+        """photon index: per-shard index maps + reference feature-lists
+        format (FeatureIndexingDriver / NameAndTermFeatureBagsDriver)."""
+        from photon_tpu.cli.index import load_index_maps, main
+
+        train, _ = glmix_avro
+        out = tmp_path / "vocab"
+        assert main(["--input", str(train), "--output", str(out)]) == 0
+        summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["shards"]["features"] == 6  # 5 features + intercept
+
+        # Whitelist: "name<TAB>term" per line, sorted distinct pairs.
+        lines = (out / "features").read_text().strip().splitlines()
+        assert len(lines) == 5
+        assert all("\t" in line for line in lines)
+
+        maps = load_index_maps(str(out))
+        assert set(maps) == {"features"}
+        assert maps["features"].intercept_index is not None
+
+    def test_train_with_prebuilt_index(self, tmp_path, glmix_avro, capsys):
+        """Training with a prebuilt vocab reproduces the auto-built-vocab
+        model (same features, same indices after remap)."""
+        from photon_tpu.cli.index import main as index_main
+        from photon_tpu.cli.train import main as train_main
+
+        train, val = glmix_avro
+        out = tmp_path / "vocab"
+        assert index_main(
+            ["--input", str(train), "--output", str(out)]) == 0
+
+        cfg_path, _ = _config(
+            tmp_path, train, val,
+            input={"format": "avro", "train_path": str(train),
+                   "validation_path": str(val), "id_tags": ["userId"],
+                   "feature_index_dir": str(out)},
+        )
+        assert train_main(["--config", str(cfg_path)]) == 0
+        res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert res["evaluation"]["RMSE"] < 0.3
+
+    def test_multi_bag_shards(self, tmp_path):
+        """Shard specs union multiple feature-bag fields (the Yahoo! Music
+        userFeatures/songFeatures layout)."""
+        from photon_tpu.cli.index import main
+
+        ref = ("/root/reference/photon-client/src/integTest/resources/"
+               "GameIntegTest/input/duplicateFeatures/yahoo-music-train.avro")
+        if not os.path.isfile(ref):
+            pytest.skip("reference fixture not mounted")
+        out = tmp_path / "vocab"
+        assert main([
+            "--input", ref, "--output", str(out),
+            "--shards", "global=features", "user=userFeatures",
+            "song=songFeatures,features",
+        ]) == 0
+        maps_dir = sorted(p.name for p in out.iterdir())
+        assert "global.index.json" in maps_dir
+        assert "user.index.json" in maps_dir
+        assert "song.index.json" in maps_dir
+        user_lines = (out / "user").read_text().strip().splitlines()
+        assert all(line.split("\t")[0] == "u" for line in user_lines)
+
+
+class TestObservability:
+    def test_output_modes(self, tmp_path, glmix_avro, capsys):
+        """ModelOutputMode.scala:47 NONE/EXPLICIT/TUNED semantics."""
+        from photon_tpu.cli.train import main
+
+        train, val = glmix_avro
+        coords = {
+            "global": {
+                "type": "fixed",
+                "regularization": {"type": "L2", "weights": [100.0, 0.01]},
+            },
+        }
+        # NONE: summary only, no model dirs.
+        cfg_path, _ = _config(
+            tmp_path, train, val, coordinates=coords,
+            model_output_mode="NONE",
+            output_dir=str(tmp_path / "none_out"),
+        )
+        assert main(["--config", str(cfg_path)]) == 0
+        assert (tmp_path / "none_out" / "training-summary.json").is_file()
+        assert not (tmp_path / "none_out" / "models").exists()
+
+        # EXPLICIT: best + every grid model, none of the tuned ones.
+        cfg_path, _ = _config(
+            tmp_path, train, val, coordinates={
+                "global": {
+                    "type": "fixed",
+                    "regularization": {
+                        "type": "L2", "weights": [100.0, 0.01]},
+                },
+            },
+            model_output_mode="EXPLICIT",
+            hyperparameter_tuning={
+                "mode": "RANDOM", "iterations": 2, "seed": 3},
+            output_dir=str(tmp_path / "exp_out"),
+        )
+        assert main(["--config", str(cfg_path)]) == 0
+        dirs = sorted(
+            p.name for p in (tmp_path / "exp_out" / "models").iterdir())
+        # EXPLICIT: best + the grid models (indices 0-1); tuned models
+        # (indices 2-3) are never saved under their config dirs.
+        assert "best" in dirs
+        assert not {"config_2", "config_3"} & set(dirs)
+        assert {d for d in dirs if d != "best"} <= {"config_0", "config_1"}
+        summary = json.loads(
+            (tmp_path / "exp_out" / "training-summary.json").read_text())
+        assert summary["num_configurations"] == 4
+
+        # TUNED: best + tuned models only.
+        cfg_path, _ = _config(
+            tmp_path, train, val, coordinates={
+                "global": {
+                    "type": "fixed",
+                    "regularization": {
+                        "type": "L2", "weights": [100.0, 0.01]},
+                },
+            },
+            model_output_mode="TUNED",
+            hyperparameter_tuning={
+                "mode": "RANDOM", "iterations": 2, "seed": 3},
+            output_dir=str(tmp_path / "tuned_out"),
+        )
+        assert main(["--config", str(cfg_path)]) == 0
+        dirs = sorted(
+            p.name for p in (tmp_path / "tuned_out" / "models").iterdir())
+        assert "best" in dirs
+        # Grid configs are 0 and 1; they may appear only as "best".
+        assert "config_0" not in dirs and "config_1" not in dirs
+
+    def test_per_group_evaluation_output(self, tmp_path, glmix_avro,
+                                         capsys, rng):
+        """savePerGroupEvaluationToHDFS equivalent: grouped AUC per group
+        key written next to the models."""
+        from photon_tpu.cli.train import main
+        from photon_tpu.io.avro_data import write_training_examples
+        from photon_tpu.types import DELIMITER
+
+        # Binary task with a grouped AUC evaluator.
+        n, d, users = 900, 4, 8
+        keys = [f"f{i}{DELIMITER}t" for i in range(d)]
+        w = rng.normal(size=d)
+
+        def write(path, seed):
+            r = np.random.default_rng(seed)
+            x = r.normal(size=(n, d))
+            uid = r.integers(0, users, size=n)
+            z = x @ w + 0.5 * r.normal(size=n)
+            y = (z > 0).astype(float)
+            rows = [[(keys[j], float(x[i, j])) for j in range(d)]
+                    for i in range(n)]
+            meta = [{"userId": f"u{u}"} for u in uid]
+            write_training_examples(str(path), y, rows, metadata=meta)
+
+        tr, va = tmp_path / "t.avro", tmp_path / "v.avro"
+        write(tr, 1)
+        write(va, 2)
+        cfg_path, _ = _config(
+            tmp_path, tr, va,
+            task="LOGISTIC_REGRESSION",
+            coordinates={
+                "global": {
+                    "type": "fixed",
+                    "regularization": {"type": "L2", "weights": [0.1]},
+                },
+            },
+            evaluators=["AUC", "AUC:userId"],
+        )
+        assert main(["--config", str(cfg_path)]) == 0
+        ge = tmp_path / "out" / "group-evaluation" / "0"
+        assert ge.is_dir()
+        payload = json.loads((ge / "AUC_userId.json").read_text())
+        assert len(payload) == users
+        assert all(0.0 <= v <= 1.0 for v in payload.values())
+        assert all(k.startswith("u") for k in payload)
