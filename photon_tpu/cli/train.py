@@ -108,6 +108,11 @@ def main(argv=None) -> int:
                 "training ingest reads the 'features' bag")
         prebuilt_features_map = prebuilt_maps["features"]
 
+    if cfg.input_format != "avro" and prebuilt_features_map is not None:
+        raise ValueError(
+            "feature_index_dir applies to avro input only; libsvm data is "
+            "identity-indexed (IdentityIndexMapLoader semantics)")
+
     if cfg.input_format == "avro":
         train, index_map = read_training_examples(
             cfg.train_path,

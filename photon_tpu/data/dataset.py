@@ -95,7 +95,89 @@ class SparseFeatures:
         return jnp.zeros(self.d, dtype=contrib.dtype).at[self.indices].add(contrib)
 
 
-Features = Union[DenseFeatures, SparseFeatures]
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DualEllFeatures:
+    """Bounded-width ELL slab + COO overflow tail.
+
+    Plain ELL sizes every row at the GLOBAL max nnz — one dense row inflates
+    the whole table (the SURVEY §7.3 width hazard). Here the slab width is
+    capped; entries beyond the cap spill into a COO tail whose contributions
+    are segment-summed back per row. Storage is O(n * cap + overflow) instead
+    of O(n * max_nnz), which is what makes heavy-tailed bag-of-features data
+    (the reference's domain) storable at scale.
+
+    ``tail_rows`` MUST be sorted ascending (segment_sum indices_are_sorted).
+    """
+
+    indices: Array  # [n, cap] int32; padding -> (0, value 0)
+    values: Array  # [n, cap]
+    tail_rows: Array  # [t] int32 row id per overflow entry, sorted
+    tail_indices: Array  # [t] int32
+    tail_values: Array  # [t]
+    d: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def num_features(self) -> int:
+        return self.d
+
+    @property
+    def num_rows(self) -> int:
+        return self.indices.shape[0]
+
+    def matvec(self, w: Array) -> Array:
+        base = jnp.sum(self.values * w[self.indices], axis=-1)
+        tail = self.tail_values * w[self.tail_indices]
+        return base + jax.ops.segment_sum(
+            tail, self.tail_rows, num_segments=self.num_rows,
+            indices_are_sorted=True,
+        )
+
+    def rmatvec(self, g: Array) -> Array:
+        contrib = self.values * g[:, None]
+        out = jnp.zeros(self.d, dtype=contrib.dtype).at[self.indices].add(
+            contrib)
+        return out.at[self.tail_indices].add(
+            self.tail_values * g[self.tail_rows])
+
+    def rmatvec_sq(self, g: Array) -> Array:
+        contrib = self.values * self.values * g[:, None]
+        out = jnp.zeros(self.d, dtype=contrib.dtype).at[self.indices].add(
+            contrib)
+        return out.at[self.tail_indices].add(
+            self.tail_values * self.tail_values * g[self.tail_rows])
+
+
+def ell_to_dual_ell(
+    indices: np.ndarray,  # [n, k] host-side
+    values: np.ndarray,  # [n, k]
+    num_features: int,
+    width_cap: int,
+    dtype=np.float32,
+) -> DualEllFeatures:
+    """Split an ELL slab at ``width_cap``: widest entries spill to the tail."""
+    n, k = indices.shape
+    cap = max(min(width_cap, k), 1)
+    present = values != 0.0
+    # Compact valid entries left so the first `cap` slots hold real entries.
+    order = np.argsort(~present, axis=1, kind="stable")
+    idx_c = np.take_along_axis(np.where(present, indices, 0), order, axis=1)
+    val_c = np.take_along_axis(np.where(present, values, 0.0), order, axis=1)
+    tail_mask = val_c[:, cap:] != 0.0
+    rows = np.broadcast_to(
+        np.arange(n, dtype=np.int64)[:, None], tail_mask.shape)
+    return DualEllFeatures(
+        indices=jnp.asarray(idx_c[:, :cap].astype(np.int32)),
+        values=jnp.asarray(val_c[:, :cap], dtype=dtype),
+        tail_rows=jnp.asarray(rows[tail_mask].astype(np.int32)),
+        tail_indices=jnp.asarray(
+            idx_c[:, cap:][tail_mask].astype(np.int32)),
+        tail_values=jnp.asarray(val_c[:, cap:][tail_mask], dtype=dtype),
+        d=num_features,
+    )
+
+
+Features = Union[DenseFeatures, SparseFeatures, DualEllFeatures]
 
 
 @jax.tree_util.register_dataclass
@@ -210,8 +292,14 @@ def pad_batch(batch: GLMBatch, multiple: int) -> GLMBatch:
     feats = batch.features
     if isinstance(feats, DenseFeatures):
         feats = DenseFeatures(pad1(feats.x))
-    else:
+    elif isinstance(feats, SparseFeatures):
         feats = SparseFeatures(pad1(feats.indices), pad1(feats.values), feats.d)
+    else:
+        raise TypeError(
+            "pad_batch/shard_batch do not support DualEllFeatures: the COO "
+            "tail is not row-aligned, so row sharding would misroute it. "
+            "Use plain SparseFeatures for data-axis sharding, or "
+            "FeatureShardedSparse for the feature axis.")
     return GLMBatch(
         features=feats,
         labels=pad1(batch.labels),

@@ -61,6 +61,9 @@ class RandomEffectDataConfiguration:
     active_data_lower_bound: int | None = None
     features_to_samples_ratio: float | None = None
     bucket_caps: tuple[int, ...] = DEFAULT_BUCKET_CAPS
+    # Scoring-table ELL width bound (SURVEY §7.3 width hazard): rows with
+    # more nnz spill into a COO tail instead of inflating every row's slab.
+    score_table_width_cap: int | None = None
 
 
 @jax.tree_util.register_dataclass
@@ -110,6 +113,11 @@ class RandomEffectDataset:
     sub_dims: np.ndarray  # [E] host-side subspace dims
     proj_all: np.ndarray  # [E, max_sub_dim] original feature ids; -1 pad
     num_features: int  # original feature-space dim of the shard
+    # COO overflow tail for rows wider than the configured score-table cap
+    # (empty arrays when uncapped); tail rows are sorted ascending.
+    score_tail_rows: Array | None = None  # [t] int32
+    score_tail_indices: Array | None = None  # [t] int32 subspace slots
+    score_tail_values: Array | None = None  # [t]
 
     def real_entity_mask(self, block: EntityBlocks) -> np.ndarray:
         """[B] bool — True for real entities. Mesh-sharded blocks pad the
@@ -221,16 +229,27 @@ def _build_score_table(
     num_entities: int,
     num_features: int,
     sort: tuple[np.ndarray, np.ndarray, np.ndarray] | None = None,
-) -> tuple[np.ndarray, np.ndarray]:
+    width_cap: int | None = None,
+):
     """Shared scoring-table remap: every row's ELL entries mapped into its
     owning entity's subspace (dropped features zeroed). Used by the dataset
     build (active+passive rows) and by ``remap_for_scoring`` (new data).
     ``sort`` optionally supplies a precomputed (order, starts, ends)
-    entity grouping to skip the argsort."""
+    entity grouping to skip the argsort.
+
+    ``width_cap`` bounds the slab width (SURVEY §7.3 width hazard): the
+    [n, cap] slab is the ONLY O(n)-wide allocation — entries beyond the cap
+    stream into a COO tail per entity, so one dense row never inflates host
+    (or device) memory for every row. Returns (si, sv, tail) where tail is
+    None when uncapped, else (rows, indices, values) sorted by row."""
     n = codes.shape[0]
     k_all = max(int((ell_val != 0.0).sum(axis=1).max(initial=0)), 1)
-    si = np.zeros((n, k_all), dtype=np.int32)
-    sv = np.zeros((n, k_all), dtype=ell_val.dtype)
+    k_slab = k_all if width_cap is None else max(min(width_cap, k_all), 1)
+    si = np.zeros((n, k_slab), dtype=np.int32)
+    sv = np.zeros((n, k_slab), dtype=ell_val.dtype)
+    tail_rows: list[np.ndarray] = []
+    tail_idx: list[np.ndarray] = []
+    tail_val: list[np.ndarray] = []
     if sort is not None:
         order, starts, ends = sort
     else:
@@ -255,11 +274,39 @@ def _build_score_table(
             continue
         p = projs_of(e)
         lut[p] = np.arange(p.size)
-        si[rows], sv[rows] = _remap_ell_rows(
-            ell_idx[rows], ell_val[rows], lut, k_all
-        )
+        # Remap at this entity's own width; only the transient per-entity
+        # buffer sees the full width.
+        k_e = max(int((ell_val[rows] != 0.0).sum(axis=1).max(initial=0)), 1)
+        ri, rv = _remap_ell_rows(ell_idx[rows], ell_val[rows], lut, k_e)
+        if k_e <= k_slab:
+            si[rows, :k_e] = ri
+            sv[rows, :k_e] = rv
+        else:
+            si[rows] = ri[:, :k_slab]
+            sv[rows] = rv[:, :k_slab]
+            over_i, over_v = ri[:, k_slab:], rv[:, k_slab:]
+            mask = over_v != 0.0
+            if mask.any():
+                row_of = np.broadcast_to(
+                    rows[:, None].astype(np.int64), mask.shape)
+                tail_rows.append(row_of[mask])
+                tail_idx.append(over_i[mask].astype(np.int64))
+                tail_val.append(over_v[mask])
         lut[p] = -1
-    return si, sv
+    if width_cap is None:
+        return si, sv, None
+    if tail_rows:
+        tr = np.concatenate(tail_rows)
+        ti = np.concatenate(tail_idx)
+        tv = np.concatenate(tail_val)
+        o = np.argsort(tr, kind="stable")  # segment_sum wants sorted rows
+        tail = (tr[o], ti[o], tv[o])
+    else:
+        tail = (
+            np.empty(0, np.int64), np.empty(0, np.int64),
+            np.empty(0, ell_val.dtype),
+        )
+    return si, sv, tail
 
 
 def remap_for_scoring(
@@ -301,7 +348,7 @@ def remap_for_scoring(
     ell_idx, ell_val, num_features = _rows_to_coo(
         game_data.feature_shards[feature_shard_id]
     )
-    si, sv = _build_score_table(
+    si, sv, _ = _build_score_table(
         codes,
         ell_idx,
         ell_val,
@@ -498,7 +545,7 @@ def build_random_effect_dataset(
         )
 
     # --- 4. full-table scoring arrays (active + passive rows) -------------
-    si, sv = _build_score_table(
+    si, sv, tail = _build_score_table(
         codes.astype(np.int64),
         ell_idx,
         ell_val,
@@ -506,7 +553,13 @@ def build_random_effect_dataset(
         num_entities,
         num_features,
         sort=(perm, starts, ends),  # reuse the (entity, hash) lexsort
+        width_cap=config.score_table_width_cap,
     )
+    tail_r = tail_i = tail_v = None
+    if tail is not None:
+        tail_r = jnp.asarray(tail[0].astype(np.int32))
+        tail_i = jnp.asarray(tail[1].astype(np.int32))
+        tail_v = jnp.asarray(tail[2], dtype=dtype)
 
     return RandomEffectDataset(
         config=config,
@@ -520,4 +573,7 @@ def build_random_effect_dataset(
         sub_dims=sub_dims,
         proj_all=proj_all,
         num_features=num_features,
+        score_tail_rows=tail_r,
+        score_tail_indices=tail_i,
+        score_tail_values=tail_v,
     )

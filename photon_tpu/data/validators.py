@@ -17,7 +17,11 @@ import enum
 
 import numpy as np
 
-from photon_tpu.data.dataset import DenseFeatures, SparseFeatures
+from photon_tpu.data.dataset import (
+    DenseFeatures,
+    DualEllFeatures,
+    SparseFeatures,
+)
 from photon_tpu.data.game_data import GameDataset
 from photon_tpu.types import TaskType
 
@@ -55,6 +59,16 @@ def _feature_finite_rows(features, rows) -> np.ndarray:
     """Per-row all-finite mask for the selected rows of a feature shard
     (finiteFeatures); ``rows`` subsets BEFORE the scan so VALIDATE_SAMPLE
     only reads its 10%."""
+    if isinstance(features, DualEllFeatures):
+        ok = np.isfinite(np.asarray(features.values)[rows]).all(axis=1)
+        tv = np.asarray(features.tail_values)
+        bad_tail_rows = np.asarray(features.tail_rows)[~np.isfinite(tv)]
+        if bad_tail_rows.size:
+            n = features.num_rows
+            bad = np.zeros(n, dtype=bool)
+            bad[bad_tail_rows] = True
+            ok = ok & ~bad[np.arange(n)[rows]]
+        return ok
     if isinstance(features, SparseFeatures):
         return np.isfinite(np.asarray(features.values)[rows]).all(axis=1)
     assert isinstance(features, DenseFeatures)

@@ -80,8 +80,20 @@ class RandomEffectModel:
         return score_entity_table(self.coefficients, codes, indices, values)
 
     def score_dataset(self, dataset: RandomEffectDataset) -> Array:
-        return self.score_table(
+        base = self.score_table(
             dataset.score_codes, dataset.score_indices, dataset.score_values
+        )
+        if dataset.score_tail_rows is None or self.num_entities == 0:
+            return base
+        # Width-capped tables spill wide rows into a COO tail
+        # (RandomEffectDataConfiguration.score_table_width_cap).
+        tr = dataset.score_tail_rows
+        picked = self.coefficients[
+            dataset.score_codes[tr], dataset.score_tail_indices
+        ]
+        tail = dataset.score_tail_values * picked
+        return base + jax.ops.segment_sum(
+            tail, tr, num_segments=base.shape[0], indices_are_sorted=True
         )
 
 

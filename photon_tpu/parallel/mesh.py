@@ -17,7 +17,10 @@ row partitioning becomes an even row split.
 
 from __future__ import annotations
 
+import dataclasses as _dataclasses
+
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -106,6 +109,137 @@ def row_sharding(mesh: Mesh, ndim: int, *, axis_name: str = DATA_AXIS) -> NamedS
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+MODEL_AXIS = "model"
+
+
+@jax.tree_util.register_dataclass
+@_dataclasses.dataclass(frozen=True)
+class FeatureShardedSparse:
+    """ELL features sharded over the FEATURE axis (tensor-parallel GLM).
+
+    For d too large to replicate comfortably (SURVEY §7.3 "sparse
+    fixed-effect matvec at scale"), each device owns a contiguous feature
+    range [j*d_local, (j+1)*d_local) and holds only the ELL entries whose
+    feature falls in its range, with LOCAL indices. The coefficient vector is
+    sharded over the same axis:
+
+    - ``matvec``: per-device partial margins + one psum over ICI (the
+      feature-axis analog of ValueAndGradientAggregator's treeAggregate);
+    - ``rmatvec``/``rmatvec_sq``: purely local scatters — each feature is
+      owned by exactly one device, no collective at all.
+
+    ``d`` is padded up to a device-count multiple; the padded coefficients
+    receive no data gradient (L2 pins them at zero). ``logical_d`` is the
+    caller's true feature count.
+    """
+
+    local_indices: Array  # [n_dev, n, k_loc] int32, device-local feature ids
+    local_values: Array  # [n_dev, n, k_loc]
+    d: int = _dataclasses.field(metadata=dict(static=True))  # padded
+    logical_d: int = _dataclasses.field(metadata=dict(static=True))
+    mesh: Mesh = _dataclasses.field(metadata=dict(static=True))
+    axis: str = _dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def num_features(self) -> int:
+        return self.d
+
+    @property
+    def _d_local(self) -> int:
+        return self.d // self.mesh.shape[self.axis]
+
+    def matvec(self, w: Array):
+        from jax import shard_map
+
+        axis = self.axis
+
+        def local(idx, val, w_local):
+            z = jnp.sum(val[0] * w_local[idx[0]], axis=-1)
+            return jax.lax.psum(z, axis)
+
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=P(),
+        )(self.local_indices, self.local_values, w)
+
+    def _scatter(self, g: Array, squared: bool):
+        from jax import shard_map
+
+        d_local = self._d_local
+
+        def local(idx, val, g_rep):
+            v = val[0] * val[0] if squared else val[0]
+            contrib = v * g_rep[:, None]
+            return jnp.zeros(d_local, dtype=contrib.dtype).at[idx[0]].add(
+                contrib)
+
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(self.axis), P(self.axis), P()),
+            out_specs=P(self.axis),
+        )(self.local_indices, self.local_values, g)
+
+    def rmatvec(self, g: Array):
+        return self._scatter(g, squared=False)
+
+    def rmatvec_sq(self, g: Array):
+        return self._scatter(g, squared=True)
+
+
+def shard_features_by_column(
+    indices: np.ndarray,  # [n, k] host-side global feature ids
+    values: np.ndarray,  # [n, k]
+    num_features: int,
+    mesh: Mesh,
+    *,
+    axis_name: str = MODEL_AXIS,
+    dtype=None,
+) -> FeatureShardedSparse:
+    """Host-side build: split every row's ELL entries by feature range.
+
+    Per-device slab width is the max over devices of the max per-row local
+    nnz — rows hash features roughly uniformly, so the width is ~k/n_dev
+    plus skew, not k.
+    """
+    if dtype is None:
+        dtype = values.dtype
+    n_dev = int(mesh.shape[axis_name])
+    d_pad = ((num_features + n_dev - 1) // n_dev) * n_dev
+    d_local = d_pad // n_dev
+    n, k = indices.shape
+    owner = indices // d_local  # [n, k]
+    present = values != 0.0
+
+    k_loc = 1
+    for j in range(n_dev):
+        sel = present & (owner == j)
+        k_loc = max(k_loc, int(sel.sum(axis=1).max(initial=0)))
+
+    li = np.zeros((n_dev, n, k_loc), dtype=np.int32)
+    lv = np.zeros((n_dev, n, k_loc), dtype=values.dtype)
+    for j in range(n_dev):
+        sel = present & (owner == j)
+        # Compact this device's entries left per row.
+        order = np.argsort(~sel, axis=1, kind="stable")
+        idx_c = np.take_along_axis(
+            np.where(sel, indices - j * d_local, 0), order, axis=1)
+        val_c = np.take_along_axis(
+            np.where(sel, values, 0.0), order, axis=1)
+        li[j] = idx_c[:, :k_loc]
+        lv[j] = val_c[:, :k_loc]
+
+    place = NamedSharding(mesh, P(axis_name, None, None))
+    return FeatureShardedSparse(
+        local_indices=jax.device_put(jnp.asarray(li), place),
+        local_values=jax.device_put(jnp.asarray(lv, dtype=dtype), place),
+        d=d_pad,
+        logical_d=num_features,
+        mesh=mesh,
+        axis=axis_name,
+    )
 
 
 def shard_batch(

@@ -21,7 +21,12 @@ import dataclasses
 
 import numpy as np
 
-from photon_tpu.data.dataset import DenseFeatures, Features, SparseFeatures
+from photon_tpu.data.dataset import (
+    DenseFeatures,
+    DualEllFeatures,
+    Features,
+    SparseFeatures,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,9 +71,30 @@ class FeatureDataStatistics:
                 mx = xw.max(axis=0)
             nnz = (w[:, None] * (x != 0.0)).sum(axis=0)
         else:
-            assert isinstance(features, SparseFeatures)
+            assert isinstance(features, (SparseFeatures, DualEllFeatures))
             idx = np.asarray(features.indices)
             val = np.asarray(features.values, dtype=np.float64)
+            if isinstance(features, DualEllFeatures):
+                # Fold the COO overflow tail back into extra ELL columns so
+                # the one-pass reductions below see every entry.
+                tr = np.asarray(features.tail_rows)
+                if tr.size:
+                    n_rows = idx.shape[0]
+                    extra = int(np.bincount(tr, minlength=n_rows).max())
+                    idx = np.concatenate(
+                        [idx, np.zeros((n_rows, extra), idx.dtype)], axis=1)
+                    val = np.concatenate(
+                        [val, np.zeros((n_rows, extra), val.dtype)], axis=1)
+                    slot = np.zeros(n_rows, dtype=np.int64)
+                    base = idx.shape[1] - extra
+                    for r, fi, fv in zip(
+                        tr,
+                        np.asarray(features.tail_indices),
+                        np.asarray(features.tail_values, dtype=np.float64),
+                    ):
+                        idx[r, base + slot[r]] = fi
+                        val[r, base + slot[r]] = fv
+                        slot[r] += 1
             n = idx.shape[0]
             d = features.d
             w = np.ones(n) if weights is None else np.asarray(
