@@ -146,6 +146,11 @@ class TrainingConfig:
     data_validation: str
     feature_index_dir: str | None
     profile_dir: str | None
+    # Multi-bag shard specs (AvroDataReader.readMerged): shard -> record
+    # feature-bag fields; None means the single TrainingExampleAvro
+    # 'features' bag. id_columns exposes top-level record fields as id tags.
+    feature_shards: dict[str, list[str]] | None
+    id_columns: list[str] | None
 
     @staticmethod
     def load(path: str) -> "TrainingConfig":
@@ -179,6 +184,8 @@ class TrainingConfig:
                 raw.get("data_validation", "DISABLED")).upper(),
             feature_index_dir=raw.get("input", {}).get("feature_index_dir"),
             profile_dir=raw.get("profile_dir"),
+            feature_shards=raw.get("input", {}).get("feature_shards"),
+            id_columns=raw.get("input", {}).get("id_columns"),
         )
 
     def opt_config_sequence(self) -> list[dict[str, GLMOptimizationConfiguration]]:

@@ -34,6 +34,11 @@ def main(argv=None) -> int:
     parser.add_argument("--evaluators", nargs="*", default=None,
                         help="optional metrics, e.g. AUC RMSE AUC:userId")
     parser.add_argument("--id-tags", nargs="*", default=None)
+    parser.add_argument("--feature-shards", nargs="*", default=None,
+                        help="shard=bag[,bag...] specs for multi-bag avro "
+                             "layouts (must match the model's shards)")
+    parser.add_argument("--id-columns", nargs="*", default=None,
+                        help="top-level record fields to expose as id tags")
     parser.add_argument("--data-validation", default="DISABLED",
                         help="FULL | SAMPLE | DISABLED")
     parser.add_argument("--backend", default=None)
@@ -59,13 +64,6 @@ def main(argv=None) -> int:
     # from the data are dropped at model load; that is harmless — a feature
     # no row carries contributes zero margin either way.
     records = avro.read_container_dir(args.input)
-    index_map = build_index_map_from_records(records)
-    data, _ = read_training_examples(
-        args.input, index_map=index_map, id_tag_names=args.id_tags,
-        records=records,
-    )
-    # Every shard named by the model resolves against the data's single
-    # feature table.
     needed_shards = set()
     import os.path as osp
     for kind in ("fixed-effect", "random-effect"):
@@ -74,18 +72,51 @@ def main(argv=None) -> int:
             for name in os.listdir(d):
                 with open(osp.join(d, name, "id-info")) as f:
                     needed_shards.add(f.read().strip().splitlines()[-1])
-    index_maps = {s: index_map for s in needed_shards} or {
-        "features": index_map}
-    model, metadata = load_game_model(args.model_dir, index_maps)
+
+    if args.feature_shards:
+        # Multi-bag layout: per-shard tables + per-shard index maps — the
+        # scoring twin of the training driver's read_merged path.
+        from photon_tpu.cli.index import parse_shard_spec
+        from photon_tpu.io.avro_data import read_merged
+
+        shard_bags = parse_shard_spec(args.feature_shards)
+        missing = sorted(needed_shards - set(shard_bags))
+        if missing:
+            raise ValueError(
+                f"model needs feature shard(s) {missing} but "
+                f"--feature-shards only defines {sorted(shard_bags)}")
+        data, index_maps = read_merged(
+            args.input,
+            feature_shards=shard_bags,
+            id_columns=args.id_columns,
+            id_tag_names=args.id_tags,
+            records=records,
+        )
+        model, metadata = load_game_model(args.model_dir, index_maps)
+    else:
+        if len(needed_shards - {"features"}) > 1:
+            raise ValueError(
+                f"model was trained on multiple feature shards "
+                f"{sorted(needed_shards)}; pass --feature-shards so each "
+                "resolves against its own bags (aliasing them all to the "
+                "single 'features' table would silently zero the random "
+                "effects)")
+        index_map = build_index_map_from_records(records)
+        data, _ = read_training_examples(
+            args.input, index_map=index_map, id_tag_names=args.id_tags,
+            records=records,
+        )
+        index_maps = {s: index_map for s in needed_shards} or {
+            "features": index_map}
+        model, metadata = load_game_model(args.model_dir, index_maps)
+        data = _alias_shards(data, needed_shards)
 
     from photon_tpu.data.validators import sanity_check_data
 
-    # Scoring rows may carry dummy labels; validate everything else
-    # (before shard aliasing so the single table is scanned once).
+    # Scoring rows may carry dummy labels; validate everything else.
     sanity_check_data(
         data, model.task, args.data_validation, check_labels=False,
     )
-    data = _alias_shards(data, needed_shards)
     transformer = GameTransformer(model)
     scores, evaluation = transformer.transform(
         data, evaluators=args.evaluators

@@ -45,7 +45,7 @@ def main(argv=None) -> int:
     from photon_tpu.cli.config import TrainingConfig
     from photon_tpu.data.libsvm import read_libsvm
     from photon_tpu.data.index_map import IndexMap
-    from photon_tpu.io.avro_data import read_training_examples
+    from photon_tpu.io.avro_data import read_merged, read_training_examples
     from photon_tpu.io.model_io import (
         load_game_model,
         save_checkpoint,
@@ -97,10 +97,11 @@ def main(argv=None) -> int:
                  len(prebuilt_maps), cfg.feature_index_dir)
 
     prebuilt_features_map = None
-    if prebuilt_maps is not None:
-        # Avro ingest reads the single 'features' bag; any other shard name
+    if prebuilt_maps is not None and not cfg.feature_shards:
+        # Single-bag ingest reads the 'features' bag; any other shard name
         # in the vocab dir cannot be consumed here and silently training on
-        # the wrong vocabulary would be worse than failing.
+        # the wrong vocabulary would be worse than failing. (Multi-shard
+        # configs pass the whole map dict into read_merged instead.)
         if "features" not in prebuilt_maps:
             raise ValueError(
                 f"feature_index_dir {cfg.feature_index_dir!r} has no "
@@ -108,12 +109,44 @@ def main(argv=None) -> int:
                 "training ingest reads the 'features' bag")
         prebuilt_features_map = prebuilt_maps["features"]
 
-    if cfg.input_format != "avro" and prebuilt_features_map is not None:
+    if cfg.input_format != "avro" and (
+        cfg.feature_index_dir or cfg.feature_shards
+    ):
         raise ValueError(
-            "feature_index_dir applies to avro input only; libsvm data is "
-            "identity-indexed (IdentityIndexMapLoader semantics)")
+            "feature_index_dir / feature_shards apply to avro input only; "
+            "libsvm data is identity-indexed single-shard "
+            "(IdentityIndexMapLoader semantics)")
 
-    if cfg.input_format == "avro":
+    multi_shard_maps = None
+    if cfg.input_format == "avro" and cfg.feature_shards:
+        if prebuilt_maps is not None:
+            missing = sorted(set(cfg.feature_shards) - set(prebuilt_maps))
+            if missing:
+                raise ValueError(
+                    f"feature_index_dir {cfg.feature_index_dir!r} does not "
+                    f"cover shard(s) {missing}; a partially prebuilt "
+                    "vocabulary would silently train those shards on a "
+                    "data-derived one")
+        # Multi-bag layout (AvroDataReader.readMerged): one index map and
+        # one ELL matrix per configured shard.
+        train, multi_shard_maps = read_merged(
+            cfg.train_path,
+            feature_shards=cfg.feature_shards,
+            index_maps=prebuilt_maps,
+            id_columns=cfg.id_columns,
+            id_tag_names=cfg.id_tags,
+        )
+        index_map = next(iter(multi_shard_maps.values()))
+        validation = None
+        if cfg.validation_path:
+            validation, _ = read_merged(
+                cfg.validation_path,
+                feature_shards=cfg.feature_shards,
+                index_maps=multi_shard_maps,
+                id_columns=cfg.id_columns,
+                id_tag_names=cfg.id_tags,
+            )
+    elif cfg.input_format == "avro":
         train, index_map = read_training_examples(
             cfg.train_path,
             index_map=prebuilt_features_map,
@@ -148,12 +181,19 @@ def main(argv=None) -> int:
         sanity_check_data(validation, cfg.task, cfg.data_validation)
 
     shards = sorted(train.feature_shards)
-    index_maps = {s: index_map for s in shards}
-    intercept_indices = {}
-    if index_map.intercept_index is not None:
+    if multi_shard_maps is not None:
+        index_maps = dict(multi_shard_maps)
         intercept_indices = {
-            s: index_map.intercept_index for s in shards
+            s: m.intercept_index for s, m in multi_shard_maps.items()
+            if m.intercept_index is not None
         }
+    else:
+        index_maps = {s: index_map for s in shards}
+        intercept_indices = {}
+        if index_map.intercept_index is not None:
+            intercept_indices = {
+                s: index_map.intercept_index for s in shards
+            }
 
     # ------------------------------------------------------------------
     # warm start (loadGameModelFromHDFS :395-404)

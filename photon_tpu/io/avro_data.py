@@ -9,10 +9,10 @@ Constants.DELIMITER, AvroDataReader readMerged :85-145), and surfaces
 metadataMap entries as id tags (the GameDatum idTagToValueMap used for
 random-effect grouping and grouped evaluation, GameConverters.scala:44).
 
-Here every shard reads the record's single ``features`` array (the
-TrainingExampleAvro layout); multi-bag shard merging applies when records
-carry bag-named metadata — the reference's multi-bag Avro layouts can be
-mapped onto this via ``feature_bag_keys``.
+``read_training_examples`` reads the single-bag TrainingExampleAvro layout
+(one shard named "features"); ``read_merged`` is the full readMerged: each
+configured shard unions one or more feature-bag record fields, with
+top-level id columns and/or metadataMap entries as id tags.
 """
 
 from __future__ import annotations
@@ -59,64 +59,139 @@ def read_training_examples(
         records = avro.read_container_dir(path)
     if not records:
         raise ValueError(f"no records in {path}")
-    if index_map is None:
-        index_map = build_index_map_from_records(
-            records, add_intercept=add_intercept
-        )
-    intercept = index_map.intercept_index
-
     if id_tag_names is None:
         # Union over ALL records: any key may be absent from the first one.
         found: set[str] = set()
         for rec in records:
             found.update((rec.get("metadataMap") or {}).keys())
         id_tag_names = sorted(found)
+    game, maps = read_merged(
+        path,
+        feature_shards={"features": ["features"]},
+        index_maps=None if index_map is None else {"features": index_map},
+        id_tag_names=id_tag_names,
+        response_field="label",
+        add_intercept=add_intercept,
+        dtype=dtype,
+        records=records,
+    )
+    return game, maps["features"]
 
-    labels = np.empty(len(records))
-    offsets = np.zeros(len(records))
-    weights = np.ones(len(records))
-    uids = np.empty(len(records), dtype=np.int64)
-    rows = []
-    tags: dict[str, list] = {t: [] for t in id_tag_names}
+
+def read_merged(
+    path: str,
+    *,
+    feature_shards: dict[str, list[str]],
+    index_maps: dict[str, IndexMap] | None = None,
+    id_columns: list[str] | None = None,
+    id_tag_names: list[str] | None = None,
+    response_field: str | None = None,
+    add_intercept: bool = True,
+    dtype=jnp.float32,
+    records: list[dict] | None = None,
+) -> tuple[GameDataset, dict[str, IndexMap]]:
+    """Read a multi-bag Avro layout into a multi-shard GameDataset.
+
+    The full AvroDataReader.readMerged semantics (AvroDataReader.scala
+    :85-145): each feature SHARD is the union of one or more feature-bag
+    record fields (FeatureShardConfiguration.featureBags) — e.g. the Yahoo!
+    Music layout's ``userFeatures``/``songFeatures``/``features`` bags —
+    packed into its own ELL matrix against its own index map. ``id_columns``
+    exposes top-level record fields (userId, songId, ...) as id tags;
+    ``id_tag_names`` additionally picks metadataMap entries. The response
+    comes from ``response_field`` (auto: "response" then "label").
+    """
+    if records is None:
+        records = avro.read_container_dir(path)
+    if not records:
+        raise ValueError(f"no records in {path}")
+
+    if response_field is None:
+        for candidate in ("response", "label"):
+            if candidate in records[0]:
+                response_field = candidate
+                break
+        else:
+            raise ValueError(
+                "records carry neither 'response' nor 'label'; pass "
+                "response_field explicitly")
+
+    out_maps: dict[str, IndexMap] = {}
+    for shard, bags in feature_shards.items():
+        if index_maps is not None and shard in index_maps:
+            out_maps[shard] = index_maps[shard]
+            continue
+        keys = set()
+        for rec in records:
+            for bag in bags:
+                for f in rec.get(bag) or ():
+                    keys.add(make_feature_key(f["name"], f["term"]))
+        out_maps[shard] = IndexMap.from_feature_names(
+            keys, add_intercept=add_intercept)
+
+    n = len(records)
+    labels = np.empty(n)
+    offsets = np.zeros(n)
+    weights = np.ones(n)
+    uids = np.empty(n, dtype=np.int64)
+    shard_rows: dict[str, list] = {shard: [] for shard in feature_shards}
+    id_columns = list(id_columns or ())
+    overlap = set(id_columns) & set(id_tag_names or ())
+    if overlap:
+        raise ValueError(
+            f"id name(s) {sorted(overlap)} listed in both id_columns and "
+            "id_tag_names; each id tag must come from exactly one source")
+    tags: dict[str, list] = {t: [] for t in id_columns}
+    for t in id_tag_names or ():
+        tags.setdefault(t, [])
+
     for i, rec in enumerate(records):
-        labels[i] = rec["label"]
+        labels[i] = rec[response_field]
         if rec.get("offset") is not None:
             offsets[i] = rec["offset"]
         if rec.get("weight") is not None:
             weights[i] = rec["weight"]
         uids[i] = _uid_to_int(rec.get("uid"), i)
-        row = []
-        for f in rec["features"]:
-            idx = index_map.get_index(make_feature_key(f["name"], f["term"]))
-            if idx is not None and f["value"] != 0.0:
-                row.append((idx, float(f["value"])))
-        if intercept is not None:
-            row.append((intercept, 1.0))
-        rows.append(row)
+        for shard, bags in feature_shards.items():
+            imap = out_maps[shard]
+            row = []
+            for bag in bags:
+                for f in rec.get(bag) or ():
+                    idx = imap.get_index(
+                        make_feature_key(f["name"], f["term"]))
+                    if idx is not None and f["value"] != 0.0:
+                        row.append((idx, float(f["value"])))
+            if imap.intercept_index is not None:
+                row.append((imap.intercept_index, 1.0))
+            shard_rows[shard].append(row)
+        for col in id_columns:
+            if col not in rec or rec[col] is None:
+                raise ValueError(f"record {i} is missing id column {col!r}")
+            tags[col].append(rec[col])
         meta = rec.get("metadataMap") or {}
-        for t in id_tag_names:
+        for t in id_tag_names or ():
             if t not in meta:
-                # The reference fails on a missing REId (GameConverters
-                # getGameDatumFromRow); silently pooling tagless rows under
-                # one entity would train a spurious model.
                 raise ValueError(
-                    f"record {i} is missing id tag {t!r} in metadataMap"
-                )
+                    f"record {i} is missing id tag {t!r} in metadataMap")
             tags[t].append(meta[t])
 
-    indices, values = rows_to_ell(rows, len(index_map))
+    shards = {}
+    for shard in feature_shards:
+        indices, values = rows_to_ell(
+            shard_rows[shard], len(out_maps[shard]))
+        shards[shard] = SparseFeatures(
+            jnp.asarray(indices), jnp.asarray(values, dtype=dtype),
+            len(out_maps[shard]))
     game = make_game_dataset(
         labels,
-        {"features": SparseFeatures(
-            jnp.asarray(indices), jnp.asarray(values, dtype=dtype),
-            len(index_map))},
+        shards,
         offsets=offsets,
         weights=weights,
         id_tags={t: np.asarray(v) for t, v in tags.items() if v},
         uids=uids,
         dtype=dtype,
     )
-    return game, index_map
+    return game, out_maps
 
 
 def _uid_to_int(uid, position: int) -> int:
