@@ -574,3 +574,85 @@ class TestMultiShardAvro:
         # validation metric (the per-shard resolution engaged correctly).
         assert out["evaluation"]["RMSE"] == pytest.approx(
             train_rmse, rel=1e-5)
+
+
+class TestBaselineConfigMatrix:
+    """The BASELINE.md reference config matrix through the real CLI:
+    linear/logistic/Poisson GLMs with L1/L2/elastic-net + TRON, and the
+    smoothed-hinge SVM with standardization."""
+
+    def _write_task_data(self, path, rng, task, w, n=600, d=6):
+        keys = [f"f{i}{DELIMITER}t" for i in range(d)]
+        x = rng.normal(size=(n, d))
+        z = x @ w
+        if task in ("LOGISTIC_REGRESSION", "SMOOTHED_HINGE_LOSS_LINEAR_SVM"):
+            y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(float)
+        elif task == "POISSON_REGRESSION":
+            y = rng.poisson(np.exp(np.clip(z, -4, 3))).astype(float)
+        else:
+            y = z + 0.1 * rng.normal(size=n)
+        rows = [[(keys[j], float(x[i, j])) for j in range(d)]
+                for i in range(n)]
+        write_training_examples(str(path), y, rows)
+
+    @pytest.mark.parametrize("task,reg,optimizer,metric,threshold", [
+        ("POISSON_REGRESSION", {"type": "L2", "weights": [0.1]},
+         {"type": "LBFGS"}, "POISSON_LOSS", None),
+        ("POISSON_REGRESSION", {"type": "L2", "weights": [0.1]},
+         {"type": "TRON"}, "POISSON_LOSS", None),
+        ("LOGISTIC_REGRESSION", {"type": "L1", "weights": [0.05]},
+         {"type": "LBFGS"}, "AUC", 0.8),
+        ("LOGISTIC_REGRESSION",
+         {"type": "ELASTIC_NET", "alpha": 0.5, "weights": [0.05]},
+         {"type": "LBFGS"}, "AUC", 0.8),
+        ("LINEAR_REGRESSION", {"type": "L2", "weights": [0.01]},
+         {"type": "TRON"}, "RMSE", 0.2),
+        ("SMOOTHED_HINGE_LOSS_LINEAR_SVM",
+         {"type": "L2", "weights": [0.1]},
+         {"type": "LBFGS"}, "AUC", 0.8),
+    ])
+    def test_task_reg_optimizer_combination(
+        self, tmp_path, rng, capsys, task, reg, optimizer, metric, threshold
+    ):
+        from photon_tpu.cli.train import main
+
+        tr = tmp_path / "t.avro"
+        va = tmp_path / "v.avro"
+        w = np.random.default_rng(4).normal(size=6)  # shared true model
+        self._write_task_data(tr, np.random.default_rng(5), task, w)
+        self._write_task_data(va, np.random.default_rng(6), task, w)
+        cfg = {
+            "task": task,
+            "input": {"format": "avro", "train_path": str(tr),
+                      "validation_path": str(va)},
+            "coordinates": {
+                "global": {"type": "fixed", "regularization": reg,
+                           "optimizer": optimizer},
+            },
+            # The smoothed-hinge + standardization config from BASELINE.md.
+            "normalization": ("STANDARDIZATION"
+                              if task == "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+                              else "NONE"),
+            "evaluators": [metric],
+            "output_dir": str(tmp_path / "out"),
+        }
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps(cfg))
+        assert main(["--config", str(p)]) == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        value = out["evaluation"][metric]
+        assert np.isfinite(value)
+        if threshold is not None:
+            if metric == "RMSE":
+                assert value < threshold
+            else:
+                assert value > threshold
+        if reg["type"] in ("L1", "ELASTIC_NET"):
+            # OWL-QN must produce a genuinely sparse model.
+            from photon_tpu.io import avro
+
+            recs = avro.read_container_dir(
+                str(tmp_path / "out" / "models" / "best" / "fixed-effect" /
+                    "global" / "coefficients"))
+            nnz = sum(1 for ntv in recs[0]["means"] if ntv["value"] != 0.0)
+            assert nnz <= 7  # d + intercept
