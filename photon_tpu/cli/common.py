@@ -1,0 +1,39 @@
+"""Shared CLI plumbing: logging setup with an optional persistent sink."""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+
+@contextlib.contextmanager
+def cli_logging(verbose: bool, log_file: str | None,
+                fmt: str = "%(asctime)s %(name)s %(levelname)s %(message)s"):
+    """Console logging at WARNING (INFO with ``verbose``) plus an optional
+    INFO-level file sink (the PhotonLogger equivalent,
+    util/PhotonLogger.scala:34). Gating happens at the HANDLER level so the
+    file sink can capture INFO without flooding the console, and the file
+    handler is detached and closed on exit — repeated ``main()`` calls in
+    one process (tests, notebooks) don't leak handlers or level state.
+    """
+    root = logging.getLogger()
+    console = logging.StreamHandler()
+    console.setLevel(logging.INFO if verbose else logging.WARNING)
+    console.setFormatter(logging.Formatter(fmt))
+    handlers = [console]
+    if log_file:
+        sink = logging.FileHandler(log_file)
+        sink.setLevel(logging.INFO)
+        sink.setFormatter(logging.Formatter(fmt))
+        handlers.append(sink)
+    prev_level = root.level
+    root.setLevel(logging.INFO)
+    for h in handlers:
+        root.addHandler(h)
+    try:
+        yield
+    finally:
+        for h in handlers:
+            root.removeHandler(h)
+            h.close()
+        root.setLevel(prev_level)

@@ -43,13 +43,20 @@ def main(argv=None) -> int:
                         help="FULL | SAMPLE | DISABLED")
     parser.add_argument("--backend", default=None)
     parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--log-file", default=None,
+                        help="also write logs to this file (PhotonLogger "
+                             "equivalent, util/PhotonLogger.scala:34)")
     args = parser.parse_args(argv)
 
     if args.backend:
         os.environ["JAX_PLATFORMS"] = args.backend
-    logging.basicConfig(
-        level=logging.INFO if args.verbose else logging.WARNING)
+    from photon_tpu.cli.common import cli_logging
 
+    with cli_logging(args.verbose, args.log_file):
+        return _run(args)
+
+
+def _run(args) -> int:
     import numpy as np
 
     from photon_tpu.io.avro_data import (

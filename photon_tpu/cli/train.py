@@ -31,14 +31,20 @@ def main(argv=None) -> int:
     parser.add_argument("--backend", default=None,
                         help="JAX platform override (tpu, cpu, axon, ...)")
     parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--log-file", default=None,
+                        help="also write logs to this file (PhotonLogger "
+                             "equivalent, util/PhotonLogger.scala:34)")
     args = parser.parse_args(argv)
 
     if args.backend:
         os.environ["JAX_PLATFORMS"] = args.backend
-    logging.basicConfig(
-        level=logging.INFO if args.verbose else logging.WARNING,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    from photon_tpu.cli.common import cli_logging
+
+    with cli_logging(args.verbose, args.log_file):
+        return _run(args)
+
+
+def _run(args) -> int:
     log = logging.getLogger("photon.train")
 
     # Imports follow the backend env override.

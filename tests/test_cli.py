@@ -600,10 +600,10 @@ class TestBaselineConfigMatrix:
          {"type": "LBFGS"}, "POISSON_LOSS", None),
         ("POISSON_REGRESSION", {"type": "L2", "weights": [0.1]},
          {"type": "TRON"}, "POISSON_LOSS", None),
-        ("LOGISTIC_REGRESSION", {"type": "L1", "weights": [0.05]},
+        ("LOGISTIC_REGRESSION", {"type": "L1", "weights": [20.0]},
          {"type": "LBFGS"}, "AUC", 0.8),
         ("LOGISTIC_REGRESSION",
-         {"type": "ELASTIC_NET", "alpha": 0.5, "weights": [0.05]},
+         {"type": "ELASTIC_NET", "alpha": 0.5, "weights": [20.0]},
          {"type": "LBFGS"}, "AUC", 0.8),
         ("LINEAR_REGRESSION", {"type": "L2", "weights": [0.01]},
          {"type": "TRON"}, "RMSE", 0.2),
@@ -618,7 +618,11 @@ class TestBaselineConfigMatrix:
 
         tr = tmp_path / "t.avro"
         va = tmp_path / "v.avro"
-        w = np.random.default_rng(4).normal(size=6)  # shared true model
+        # Shared true model with genuinely null features so L1 sparsity is
+        # observable (the objective is a SUM over rows, so lambda is on the
+        # n-scale).
+        w = np.random.default_rng(4).normal(size=6)
+        w[3:] = 0.0
         self._write_task_data(tr, np.random.default_rng(5), task, w)
         self._write_task_data(va, np.random.default_rng(6), task, w)
         cfg = {
@@ -655,4 +659,17 @@ class TestBaselineConfigMatrix:
                 str(tmp_path / "out" / "models" / "best" / "fixed-effect" /
                     "global" / "coefficients"))
             nnz = sum(1 for ntv in recs[0]["means"] if ntv["value"] != 0.0)
-            assert nnz <= 7  # d + intercept
+            assert nnz < 7  # strictly sparser than dense (d=6 + intercept)
+
+
+def test_log_file_sink(tmp_path, glmix_avro, capsys):
+    """--log-file writes a persistent log (PhotonLogger parity)."""
+    from photon_tpu.cli.train import main
+
+    train, val = glmix_avro
+    cfg_path, _ = _config(tmp_path, train, val, num_iterations=1)
+    log_path = tmp_path / "photon.log"
+    assert main(["--config", str(cfg_path),
+                 "--log-file", str(log_path)]) == 0
+    text = log_path.read_text()
+    assert "executed in" in text  # Timed sections land in the sink
