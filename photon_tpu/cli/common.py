@@ -27,7 +27,9 @@ def cli_logging(verbose: bool, log_file: str | None,
         sink.setFormatter(logging.Formatter(fmt))
         handlers.append(sink)
     prev_level = root.level
-    root.setLevel(logging.INFO)
+    # INFO records are only materialized when something consumes them.
+    root.setLevel(
+        logging.INFO if (verbose or log_file) else logging.WARNING)
     for h in handlers:
         root.addHandler(h)
     try:

@@ -151,6 +151,10 @@ class TrainingConfig:
     # 'features' bag. id_columns exposes top-level record fields as id tags.
     feature_shards: dict[str, list[str]] | None
     id_columns: list[str] | None
+    # Daily-format input selection (trainDir/yyyy/MM/dd, GameDriver
+    # inputDataDateRange / inputDataDaysRange): "yyyymmdd-yyyymmdd" / "N-M".
+    date_range: str | None
+    days_range: str | None
 
     @staticmethod
     def load(path: str) -> "TrainingConfig":
@@ -186,6 +190,8 @@ class TrainingConfig:
             profile_dir=raw.get("profile_dir"),
             feature_shards=raw.get("input", {}).get("feature_shards"),
             id_columns=raw.get("input", {}).get("id_columns"),
+            date_range=raw.get("input", {}).get("date_range"),
+            days_range=raw.get("input", {}).get("days_range"),
         )
 
     def opt_config_sequence(self) -> list[dict[str, GLMOptimizationConfiguration]]:

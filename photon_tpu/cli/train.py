@@ -91,6 +91,38 @@ def _run(args) -> int:
         )
         return game, imap
 
+    # Daily-format (yyyy/MM/dd) input selection; records from every selected
+    # day concatenate into one dataset (IOUtils.getInputPathsWithinDateRange).
+    train_records = None
+    val_records = None
+    if cfg.date_range or cfg.days_range:
+        if cfg.input_format != "avro":
+            raise ValueError("date_range/days_range apply to avro input only")
+        from photon_tpu.io import avro as avro_io
+        from photon_tpu.io.paths import (
+            DateRange,
+            DaysRange,
+            paths_for_date_range,
+        )
+
+        if cfg.date_range and cfg.days_range:
+            raise ValueError("set only one of date_range / days_range")
+        rng_ = (DateRange.from_string(cfg.date_range) if cfg.date_range
+                else DaysRange.from_string(cfg.days_range).to_date_range())
+
+        def read_daily(base):
+            day_paths = paths_for_date_range(base, rng_)
+            log.info("date range %s..%s under %s -> %d daily dir(s)",
+                     rng_.start, rng_.end, base, len(day_paths))
+            recs = []
+            for p_ in day_paths:
+                recs.extend(avro_io.read_container_dir(p_))
+            return recs
+
+        train_records = read_daily(cfg.train_path)
+        if cfg.validation_path:
+            val_records = read_daily(cfg.validation_path)
+
     prebuilt_maps = None
     if cfg.feature_index_dir:
         # Prebuilt vocab from `photon index` (the FeatureIndexingDriver /
@@ -141,6 +173,7 @@ def _run(args) -> int:
             index_maps=prebuilt_maps,
             id_columns=cfg.id_columns,
             id_tag_names=cfg.id_tags,
+            records=train_records,
         )
         index_map = next(iter(multi_shard_maps.values()))
         validation = None
@@ -151,12 +184,14 @@ def _run(args) -> int:
                 index_maps=multi_shard_maps,
                 id_columns=cfg.id_columns,
                 id_tag_names=cfg.id_tags,
+                records=val_records,
             )
     elif cfg.input_format == "avro":
         train, index_map = read_training_examples(
             cfg.train_path,
             index_map=prebuilt_features_map,
             id_tag_names=cfg.id_tags,
+            records=train_records,
         )
         validation = None
         if cfg.validation_path:
@@ -164,6 +199,7 @@ def _run(args) -> int:
                 cfg.validation_path,
                 index_map=index_map,
                 id_tag_names=cfg.id_tags,
+                records=val_records,
             )
     elif cfg.input_format == "libsvm":
         train, index_map = read_libsvm_game(cfg.train_path)
@@ -311,6 +347,7 @@ def _run(args) -> int:
 
     summary = {
         "task": cfg.task.value,
+        "num_training_rows": train.num_samples,
         "num_configurations": len(results),
         "num_tuned_configurations": num_tuned,
         "best_configuration_index": best_idx,

@@ -30,6 +30,13 @@ from photon_tpu.hyperparameter.search import (
     GaussianProcessSearch,
     RandomSearch,
 )
+from photon_tpu.hyperparameter.serialization import (
+    HyperparameterConfig,
+    config_from_json,
+    prior_from_json,
+    rescale_prior_observations,
+)
+from photon_tpu.hyperparameter.shrink import get_bounds
 from photon_tpu.hyperparameter.slice_sampler import SliceSampler
 from photon_tpu.hyperparameter.tuner import HyperparameterTuningMode, search
 
@@ -48,6 +55,11 @@ __all__ = [
     "transform_forward",
     "GaussianProcessSearch",
     "RandomSearch",
+    "HyperparameterConfig",
+    "config_from_json",
+    "prior_from_json",
+    "rescale_prior_observations",
+    "get_bounds",
     "SliceSampler",
     "HyperparameterTuningMode",
     "search",

@@ -1,0 +1,75 @@
+"""Shrink the hyperparameter search range around a prior optimum.
+
+TPU-native counterpart of photon-client
+hyperparameter/ShrinkSearchRange.scala:147 (getBounds): fit a GP to prior
+observations (rescaled into the unit cube), locate the best predicted point
+over a Sobol candidate pool, and return a ``radius``-wide box around it in
+the ORIGINAL hyperparameter space, clamped to the configured ranges — the
+warm-started search-space reduction used when retraining on fresh data.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from photon_tpu.hyperparameter.gp import GaussianProcessEstimator
+from photon_tpu.hyperparameter.rescaling import scale_backward
+from photon_tpu.hyperparameter.search import _SobolGenerator
+from photon_tpu.hyperparameter.serialization import (
+    HyperparameterConfig,
+    prior_from_json,
+    rescale_prior_observations,
+)
+
+
+def _discretize(candidate: np.ndarray, discrete: dict[int, int]) -> np.ndarray:
+    out = np.array(candidate, dtype=float)
+    for index, k in discrete.items():
+        out[index] = math.floor(out[index] * k) / k
+    return out
+
+
+def get_bounds(
+    config: HyperparameterConfig,
+    prior_json: str,
+    prior_default: dict[str, str],
+    radius: float,
+    candidate_pool_size: int = 1000,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(lower, upper) bounds in original space (ShrinkSearchRange.getBounds).
+
+    The best candidate is the Sobol pool point with the LOWEST GP-predicted
+    evaluation (the search minimizes); the box [best - radius, best + radius]
+    on the unit cube maps back through scaleBackward and clamps to the
+    configured ranges.
+    """
+    priors = prior_from_json(prior_json, prior_default, config.names)
+    if not priors:
+        raise ValueError("no prior observations to shrink around")
+    rescaled = rescale_prior_observations(priors, config)
+    points = np.stack([p for p, _ in rescaled])
+    evals = np.asarray([v for _, v in rescaled])
+
+    model = GaussianProcessEstimator(kernel="matern52", seed=seed).fit(
+        points, evals)
+    candidates = _SobolGenerator(len(config.names), seed).draw(
+        candidate_pool_size)
+    means, _ = model.predict(candidates)
+    best = candidates[int(np.argmin(means))]
+
+    discrete_set = set(config.discrete_params)
+    upper = scale_backward(
+        _discretize(best + radius, config.discrete_params),
+        config.ranges, discrete_set,
+    )
+    lower = scale_backward(
+        _discretize(best - radius, config.discrete_params),
+        config.ranges, discrete_set,
+    )
+    for i, r in enumerate(config.ranges):
+        upper[i] = min(upper[i], r.end)
+        lower[i] = max(lower[i], r.start)
+    return lower, upper

@@ -460,3 +460,77 @@ class TestReviewRegressions:
         assert w[0] == pytest.approx(1.0, abs=1e-6)  # clamped at the box
 
     _make = TestGameEvaluationFunction._setup
+
+
+class TestSerializationAndShrink:
+    CONFIG_JSON = """
+    {"tuning_mode": "BAYESIAN",
+     "variables": {
+        "global.regularizer": {"type": "CONTINUOUS", "min": -4.0,
+                               "max": 4.0, "transform": "LOG"},
+        "per-user.topK": {"type": "DISCRETE", "min": 1.0, "max": 5.0}
+     }}
+    """
+
+    def test_config_from_json(self):
+        from photon_tpu.hyperparameter import (
+            HyperparameterTuningMode,
+            config_from_json,
+        )
+
+        cfg = config_from_json(self.CONFIG_JSON)
+        assert cfg.tuning_mode == HyperparameterTuningMode.BAYESIAN
+        assert cfg.names == ["global.regularizer", "per-user.topK"]
+        assert cfg.ranges[0].start == -4.0 and cfg.ranges[0].end == 4.0
+        assert cfg.discrete_params == {1: 5}  # 5 discrete values in [1, 5]
+        assert cfg.transform_map == {0: "LOG"}
+
+    def test_prior_round_trip_and_rescale(self):
+        import json as _json
+
+        from photon_tpu.hyperparameter import (
+            config_from_json,
+            prior_from_json,
+            rescale_prior_observations,
+        )
+
+        cfg = config_from_json(self.CONFIG_JSON)
+        prior = _json.dumps({"records": [
+            {"global.regularizer": "100.0", "per-user.topK": "3",
+             "evaluationValue": "0.25"},
+            {"evaluationValue": "0.5"},  # falls back to defaults
+        ]})
+        obs = prior_from_json(
+            prior, {"global.regularizer": "1.0", "per-user.topK": "1"},
+            cfg.names)
+        assert len(obs) == 2
+        np.testing.assert_allclose(obs[0][0], [100.0, 3.0])
+        assert obs[0][1] == 0.25
+        np.testing.assert_allclose(obs[1][0], [1.0, 1.0])
+
+        scaled = rescale_prior_observations(obs, cfg)
+        # log10(100) = 2 -> (2 - (-4)) / 8 = 0.75; topK 3 -> (3-1)/(4+1)=0.4.
+        np.testing.assert_allclose(scaled[0][0], [0.75, 0.4])
+
+    def test_shrink_bounds_around_prior_optimum(self):
+        """getBounds must box in the region the GP thinks is best, clamped
+        to the configured ranges (ShrinkSearchRange.scala:147)."""
+        import json as _json
+
+        from photon_tpu.hyperparameter import config_from_json, get_bounds
+
+        cfg = config_from_json("""
+        {"tuning_mode": "BAYESIAN",
+         "variables": {"lambda": {"type": "CONTINUOUS",
+                                  "min": 0.0, "max": 10.0}}}
+        """)
+        # Prior observations: a clear minimum near lambda = 7.
+        records = [
+            {"lambda": str(v), "evaluationValue": str((v - 7.0) ** 2)}
+            for v in [0.0, 2.0, 4.0, 6.0, 7.0, 8.0, 10.0]
+        ]
+        lower, upper = get_bounds(
+            cfg, _json.dumps({"records": records}), {}, radius=0.15, seed=2)
+        assert 0.0 <= lower[0] < 7.0 < upper[0] <= 10.0
+        # The box is ~2*radius of the unit cube = ~3 wide in [0, 10].
+        assert (upper[0] - lower[0]) <= 4.0
