@@ -147,10 +147,43 @@ class TrainingConfig:
     feature_index_dir: str | None
     profile_dir: str | None
     # Multi-bag shard specs (AvroDataReader.readMerged): shard -> record
-    # feature-bag fields; None means the single TrainingExampleAvro
-    # 'features' bag. id_columns exposes top-level record fields as id tags.
-    feature_shards: dict[str, list[str]] | None
+    # feature-bag fields, or shard -> {bags: [...], intercept: bool}
+    # (FeatureShardConfiguration featureBags + hasIntercept); None means the
+    # single TrainingExampleAvro 'features' bag. id_columns exposes
+    # top-level record fields as id tags.
+    feature_shards: dict[str, list[str] | dict] | None
     id_columns: list[str] | None
+
+    def shard_bags(self) -> dict[str, list[str]] | None:
+        if self.feature_shards is None:
+            return None
+        out = {}
+        for shard, spec in self.feature_shards.items():
+            if isinstance(spec, dict):
+                if "bags" not in spec:
+                    raise ValueError(
+                        f"feature shard {shard!r}: dict spec needs a "
+                        "'bags' list (and optional 'intercept' bool)")
+                bags = spec["bags"]
+            else:
+                bags = spec
+            if isinstance(bags, str) or not all(
+                isinstance(b, str) for b in bags
+            ):
+                raise ValueError(
+                    f"feature shard {shard!r}: bags must be a list of "
+                    f"record field names, got {bags!r}")
+            out[shard] = list(bags)
+        return out
+
+    def shard_intercepts(self) -> dict[str, bool]:
+        if self.feature_shards is None:
+            return {}
+        return {
+            shard: bool(spec.get("intercept", True))
+            for shard, spec in self.feature_shards.items()
+            if isinstance(spec, dict)
+        }
     # Daily-format input selection (trainDir/yyyy/MM/dd, GameDriver
     # inputDataDateRange / inputDataDaysRange): "yyyymmdd-yyyymmdd" / "N-M".
     date_range: str | None

@@ -169,10 +169,11 @@ def _run(args) -> int:
         # one ELL matrix per configured shard.
         train, multi_shard_maps = read_merged(
             cfg.train_path,
-            feature_shards=cfg.feature_shards,
+            feature_shards=cfg.shard_bags(),
             index_maps=prebuilt_maps,
             id_columns=cfg.id_columns,
             id_tag_names=cfg.id_tags,
+            add_intercept=cfg.shard_intercepts(),
             records=train_records,
         )
         index_map = next(iter(multi_shard_maps.values()))
@@ -180,7 +181,7 @@ def _run(args) -> int:
         if cfg.validation_path:
             validation, _ = read_merged(
                 cfg.validation_path,
-                feature_shards=cfg.feature_shards,
+                feature_shards=cfg.shard_bags(),
                 index_maps=multi_shard_maps,
                 id_columns=cfg.id_columns,
                 id_tag_names=cfg.id_tags,

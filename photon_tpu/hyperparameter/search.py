@@ -123,11 +123,7 @@ class RandomSearch:
         return self._sobol.draw(n)
 
     def _discretize(self, candidate: np.ndarray) -> np.ndarray:
-        """floor(v*k)/k on discrete dims (discretizeCandidate :168-180)."""
-        out = np.array(candidate, dtype=float)
-        for index, k in self.discrete_params.items():
-            out[index] = math.floor(out[index] * k) / k
-        return out
+        return discretize_candidate(candidate, self.discrete_params)
 
 
 class GaussianProcessSearch(RandomSearch):
@@ -216,3 +212,13 @@ class GaussianProcessSearch(RandomSearch):
             else int(np.argmin(predictions))
         )
         return candidates[idx]
+
+
+def discretize_candidate(
+    candidate: np.ndarray, discrete_params: dict[int, int]
+) -> np.ndarray:
+    """floor(v*k)/k on discrete dims (discretizeCandidate :168-180)."""
+    out = np.array(candidate, dtype=float)
+    for index, k in discrete_params.items():
+        out[index] = math.floor(out[index] * k) / k
+    return out

@@ -4,31 +4,27 @@ TPU-native counterpart of photon-client
 hyperparameter/ShrinkSearchRange.scala:147 (getBounds): fit a GP to prior
 observations (rescaled into the unit cube), locate the best predicted point
 over a Sobol candidate pool, and return a ``radius``-wide box around it in
-the ORIGINAL hyperparameter space, clamped to the configured ranges — the
-warm-started search-space reduction used when retraining on fresh data.
+the CONFIG-RANGE space (i.e. transformed space for LOG/SQRT variables —
+exactly what the reference's scaleBackward returns, ready to use as new
+config ranges), clamped to the configured ranges — the warm-started
+search-space reduction used when retraining on fresh data.
 """
 
 from __future__ import annotations
-
-import math
 
 import numpy as np
 
 from photon_tpu.hyperparameter.gp import GaussianProcessEstimator
 from photon_tpu.hyperparameter.rescaling import scale_backward
-from photon_tpu.hyperparameter.search import _SobolGenerator
+from photon_tpu.hyperparameter.search import (
+    _SobolGenerator,
+    discretize_candidate,
+)
 from photon_tpu.hyperparameter.serialization import (
     HyperparameterConfig,
     prior_from_json,
     rescale_prior_observations,
 )
-
-
-def _discretize(candidate: np.ndarray, discrete: dict[int, int]) -> np.ndarray:
-    out = np.array(candidate, dtype=float)
-    for index, k in discrete.items():
-        out[index] = math.floor(out[index] * k) / k
-    return out
 
 
 def get_bounds(
@@ -39,7 +35,9 @@ def get_bounds(
     candidate_pool_size: int = 1000,
     seed: int = 0,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """(lower, upper) bounds in original space (ShrinkSearchRange.getBounds).
+    """(lower, upper) bounds in config-range space
+    (ShrinkSearchRange.getBounds): for LOG/SQRT variables these are
+    transformed-space values, directly usable as new config ranges.
 
     The best candidate is the Sobol pool point with the LOWEST GP-predicted
     evaluation (the search minimizes); the box [best - radius, best + radius]
@@ -62,11 +60,11 @@ def get_bounds(
 
     discrete_set = set(config.discrete_params)
     upper = scale_backward(
-        _discretize(best + radius, config.discrete_params),
+        discretize_candidate(best + radius, config.discrete_params),
         config.ranges, discrete_set,
     )
     lower = scale_backward(
-        _discretize(best - radius, config.discrete_params),
+        discretize_candidate(best - radius, config.discrete_params),
         config.ranges, discrete_set,
     )
     for i, r in enumerate(config.ranges):

@@ -86,7 +86,7 @@ def read_merged(
     id_columns: list[str] | None = None,
     id_tag_names: list[str] | None = None,
     response_field: str | None = None,
-    add_intercept: bool = True,
+    add_intercept: bool | dict[str, bool] = True,
     dtype=jnp.float32,
     records: list[dict] | None = None,
 ) -> tuple[GameDataset, dict[str, IndexMap]]:
@@ -100,7 +100,13 @@ def read_merged(
     exposes top-level record fields (userId, songId, ...) as id tags;
     ``id_tag_names`` additionally picks metadataMap entries. The response
     comes from ``response_field`` (auto: "response" then "label").
+    ``add_intercept`` may be per-shard (FeatureShardConfiguration's
+    hasIntercept flag) or one bool for all shards.
     """
+    def shard_intercept(shard: str) -> bool:
+        if isinstance(add_intercept, dict):
+            return add_intercept.get(shard, True)
+        return add_intercept
     if records is None:
         records = avro.read_container_dir(path)
     if not records:
@@ -127,7 +133,7 @@ def read_merged(
                 for f in rec.get(bag) or ():
                     keys.add(make_feature_key(f["name"], f["term"]))
         out_maps[shard] = IndexMap.from_feature_names(
-            keys, add_intercept=add_intercept)
+            keys, add_intercept=shard_intercept(shard))
 
     n = len(records)
     labels = np.empty(n)

@@ -576,6 +576,52 @@ class TestMultiShardAvro:
             train_rmse, rel=1e-5)
 
 
+    def test_per_shard_intercept_flag(self, tmp_path, rng, capsys):
+        """FeatureShardConfiguration hasIntercept: a shard may opt out of
+        the intercept slot."""
+        from photon_tpu.cli.train import main
+        from photon_tpu.cli.index import load_index_maps  # noqa: F401
+        from photon_tpu.data.index_map import IndexMap  # noqa: F401
+        from photon_tpu.io.avro_data import read_merged
+
+        tr = tmp_path / "t.avro"
+        self._write(tr, np.random.default_rng(0), n=50)
+        data, maps = read_merged(
+            str(tr),
+            feature_shards={"g": ["features"], "u": ["userFeatures"]},
+            add_intercept={"g": True, "u": False},
+        )
+        assert maps["g"].intercept_index is not None
+        assert maps["u"].intercept_index is None
+
+        cfg = {
+            "task": "LINEAR_REGRESSION",
+            "input": {
+                "format": "avro", "train_path": str(tr),
+                "feature_shards": {
+                    "globalShard": {"bags": ["features"],
+                                    "intercept": True},
+                    "userShard": {"bags": ["userFeatures"],
+                                  "intercept": False},
+                },
+                "id_columns": ["userId"],
+            },
+            "coordinates": {
+                "global": {"type": "fixed", "feature_shard": "globalShard",
+                           "regularization": {"type": "L2",
+                                              "weights": [0.01]}},
+                "per-user": {"type": "random", "feature_shard": "userShard",
+                             "random_effect_type": "userId",
+                             "regularization": {"type": "L2",
+                                                "weights": [0.1]}},
+            },
+            "output_dir": str(tmp_path / "out"),
+        }
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps(cfg))
+        assert main(["--config", str(p)]) == 0
+
+
 class TestBaselineConfigMatrix:
     """The BASELINE.md reference config matrix through the real CLI:
     linear/logistic/Poisson GLMs with L1/L2/elastic-net + TRON, and the
