@@ -317,14 +317,18 @@ def remap_for_scoring(
     entity_keys: tuple,
     proj_all: np.ndarray,  # [E, S] original feature ids; -1 pad
     dtype=None,
-) -> tuple[Array, Array, Array]:
+    width_cap: int | None = None,
+) -> tuple[Array, Array, Array, tuple[Array, Array, Array] | None]:
     """Remap an arbitrary GameDataset's rows into trained entity subspaces.
 
-    Returns (codes, indices, values) consumable by
-    ``RandomEffectModel.score_table`` — the scoring path for validation /
+    Returns (codes, indices, values, tail) consumable by
+    ``score_entity_table_with_tail`` — the scoring path for validation /
     test data (RandomEffectModel.score :70 joins new data by REId; entities
     unseen at training time contribute score 0, matching the reference's
-    left-join semantics where rows without a model get no score).
+    left-join semantics where rows without a model get no score). ``tail``
+    is None when ``width_cap`` is unset, else device (rows, indices, values)
+    arrays for the capped table's COO overflow (the SURVEY §7.3 width
+    bound, same convention as the training-side score table).
     """
     if dtype is None:
         dtype = game_data.labels.dtype
@@ -348,21 +352,36 @@ def remap_for_scoring(
     ell_idx, ell_val, num_features = _rows_to_coo(
         game_data.feature_shards[feature_shard_id]
     )
-    si, sv, _ = _build_score_table(
+    si, sv, tail = _build_score_table(
         codes,
         ell_idx,
         ell_val,
         lambda e: proj_all[e][proj_all[e] >= 0],
         len(entity_keys),
         num_features,
+        width_cap=width_cap,
     )
     # Unseen entities: clamp the code and zero the values -> score 0.
-    sv[codes < 0] = 0.0
+    unseen = codes < 0
+    sv[unseen] = 0.0
     codes_safe = np.maximum(codes, 0)
+    tail_out = None
+    if tail is not None:
+        tr, ti, tv = tail
+        # Invariant: the tail only holds rows of KNOWN entities — the
+        # build's searchsorted grouping spans codes 0..E-1, so code -1
+        # (unseen) rows never reach the per-entity remap loop.
+        assert not unseen[tr].any()
+        tail_out = (
+            jnp.asarray(tr.astype(np.int32)),
+            jnp.asarray(ti.astype(np.int32)),
+            jnp.asarray(tv, dtype=dtype),
+        )
     return (
         jnp.asarray(codes_safe.astype(np.int32)),
         jnp.asarray(si),
         jnp.asarray(sv, dtype=dtype),
+        tail_out,
     )
 
 

@@ -274,6 +274,7 @@ class GameEstimator:
                     feature_shard_id=cfg.data.feature_shard_id,
                     entity_keys=ds.entity_keys,
                     proj_all=ds.proj_all,
+                    width_cap=cfg.data.score_table_width_cap,
                 )
             else:
                 scorers[cid] = fixed_effect_scorer(

@@ -80,20 +80,19 @@ class RandomEffectModel:
         return score_entity_table(self.coefficients, codes, indices, values)
 
     def score_dataset(self, dataset: RandomEffectDataset) -> Array:
-        base = self.score_table(
-            dataset.score_codes, dataset.score_indices, dataset.score_values
-        )
-        if dataset.score_tail_rows is None or self.num_entities == 0:
-            return base
-        # Width-capped tables spill wide rows into a COO tail
-        # (RandomEffectDataConfiguration.score_table_width_cap).
-        tr = dataset.score_tail_rows
-        picked = self.coefficients[
-            dataset.score_codes[tr], dataset.score_tail_indices
-        ]
-        tail = dataset.score_tail_values * picked
-        return base + jax.ops.segment_sum(
-            tail, tr, num_segments=base.shape[0], indices_are_sorted=True
+        tail = None
+        if dataset.score_tail_rows is not None:
+            tail = (
+                dataset.score_tail_rows,
+                dataset.score_tail_indices,
+                dataset.score_tail_values,
+            )
+        return score_entity_table_with_tail(
+            self.coefficients,
+            dataset.score_codes,
+            dataset.score_indices,
+            dataset.score_values,
+            tail,
         )
 
 
@@ -109,6 +108,26 @@ def score_entity_table(
     rows = jnp.take(w, codes, axis=0)  # [n, S]
     picked = jnp.take_along_axis(rows, indices, axis=-1)  # [n, k]
     return jnp.sum(values * picked, axis=-1)
+
+
+def score_entity_table_with_tail(
+    w: Array,
+    codes: Array,
+    indices: Array,
+    values: Array,
+    tail: tuple[Array, Array, Array] | None,
+) -> Array:
+    """score_entity_table plus a width-capped table's COO overflow tail
+    (rows sorted ascending; see RandomEffectDataConfiguration
+    .score_table_width_cap)."""
+    base = score_entity_table(w, codes, indices, values)
+    if tail is None or w.shape[0] == 0:
+        return base
+    tr, ti, tv = tail
+    picked = w[codes[tr], ti]
+    return base + jax.ops.segment_sum(
+        tv * picked, tr, num_segments=base.shape[0], indices_are_sorted=True
+    )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -22,6 +22,7 @@ from photon_tpu.models.game import (
     FixedEffectModel,
     GameModel,
     RandomEffectModel,
+    score_entity_table_with_tail,
 )
 
 Array = jax.Array
@@ -44,27 +45,33 @@ def random_effect_scorer(
     feature_shard_id: str,
     entity_keys: tuple,
     proj_all,
+    width_cap: int | None = None,
 ):
     """model -> per-row scores for a random-effect sub-model on ``data``.
 
     The expensive host-side subspace remap happens once at construction;
-    the returned closure is a pure device gather.
+    the returned closure is a pure device gather. ``width_cap`` bounds the
+    remapped table's slab width (overflow rides a COO tail).
     """
-    codes, idx, vals = remap_for_scoring(
+    codes, idx, vals, tail = remap_for_scoring(
         data,
         re_type=re_type,
         feature_shard_id=feature_shard_id,
         entity_keys=entity_keys,
         proj_all=proj_all,
+        width_cap=width_cap,
     )
 
     def scorer(m: RandomEffectModel) -> Array:
-        return m.score_table(codes, idx, vals)
+        return score_entity_table_with_tail(
+            m.coefficients, codes, idx, vals, tail
+        )
 
     return scorer
 
 
-def make_submodel_scorer(sub_model, data: GameDataset):
+def make_submodel_scorer(sub_model, data: GameDataset,
+                         width_cap: int | None = None):
     """Dispatch a scorer for one trained sub-model (GameModel.score arm)."""
     if isinstance(sub_model, RandomEffectModel):
         return random_effect_scorer(
@@ -73,6 +80,7 @@ def make_submodel_scorer(sub_model, data: GameDataset):
             feature_shard_id=sub_model.feature_shard_id,
             entity_keys=sub_model.entity_keys,
             proj_all=sub_model.proj_all,
+            width_cap=width_cap,
         )
     if isinstance(sub_model, FixedEffectModel):
         return fixed_effect_scorer(data, sub_model.feature_shard_id)

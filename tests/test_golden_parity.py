@@ -280,7 +280,7 @@ class TestEmptyRandomEffectScores:
             id_tags={"userId": np.arange(5)},
             dtype=jnp.float64,
         )
-        codes, si, sv = remap_for_scoring(
+        codes, si, sv, _ = remap_for_scoring(
             data, re_type="userId",
             feature_shard_id=pu.feature_shard_id,
             entity_keys=pu.entity_keys, proj_all=pu.proj_all,

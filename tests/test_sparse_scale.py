@@ -256,3 +256,51 @@ class TestDualEllConsumers:
         cfg_path.write_text(json.dumps(cfg))
         with pytest.raises(ValueError, match="avro input only"):
             main(["--config", str(cfg_path)])
+
+
+def test_validation_scorer_width_cap_parity(rng):
+    """remap_for_scoring with a width cap scores identically to the
+    uncapped table (tail contribution included), with unseen entities 0."""
+    from photon_tpu.data.dataset import DenseFeatures
+    from photon_tpu.data.game_data import make_game_dataset
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfiguration,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.models.game import RandomEffectModel
+    from photon_tpu.transformers import random_effect_scorer
+
+    n, d, E = 90, 8, 5
+    x = rng.normal(size=(n, d))
+    train_data = make_game_dataset(
+        rng.normal(size=n),
+        {"shard": DenseFeatures(jnp.asarray(x))},
+        id_tags={"userId": rng.integers(0, E, size=n)},
+        dtype=jnp.float64,
+    )
+    ds = build_random_effect_dataset(
+        train_data, RandomEffectDataConfiguration("userId", "shard"))
+    w = rng.normal(size=(ds.num_entities, ds.max_sub_dim))
+    w[ds.proj_all < 0] = 0.0
+    model = RandomEffectModel(
+        coefficients=jnp.asarray(w),
+        random_effect_type="userId",
+        feature_shard_id="shard",
+        task=TaskType.LINEAR_REGRESSION,
+        proj_all=ds.proj_all,
+        entity_keys=ds.entity_keys,
+    )
+    # Validation data includes entities unseen at training time.
+    m = 60
+    val = make_game_dataset(
+        rng.normal(size=m),
+        {"shard": DenseFeatures(jnp.asarray(rng.normal(size=(m, d))))},
+        id_tags={"userId": rng.integers(0, E + 3, size=m)},
+        dtype=jnp.float64,
+    )
+    kw = dict(re_type="userId", feature_shard_id="shard",
+              entity_keys=ds.entity_keys, proj_all=ds.proj_all)
+    s_full = np.asarray(random_effect_scorer(val, **kw)(model))
+    s_capped = np.asarray(
+        random_effect_scorer(val, width_cap=2, **kw)(model))
+    np.testing.assert_allclose(s_capped, s_full, rtol=1e-10)
