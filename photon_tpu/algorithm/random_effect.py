@@ -479,6 +479,12 @@ class RandomEffectCoordinate:
                 and self.config.l1_weight == 0.0
                 and self.config.l2_weight > 0.0
                 and self.config.optimizer.box_constraints is None
+                # With a prior, absent-feature slots are penalized by
+                # incremental_weight * inv_prior_var instead of l2; at
+                # incremental_weight == 0 the normal equations can be
+                # singular for entities with fewer rows than features.
+                and (self.prior is None
+                     or self.config.incremental_weight > 0.0)
             )
             w, v, it, reason = _solve_block(
                 block,

@@ -100,7 +100,7 @@ def _run(args) -> int:
         )
         model, metadata = load_game_model(args.model_dir, index_maps)
     else:
-        if len(needed_shards - {"features"}) > 1:
+        if len(needed_shards) > 1:
             raise ValueError(
                 f"model was trained on multiple feature shards "
                 f"{sorted(needed_shards)}; pass --feature-shards so each "

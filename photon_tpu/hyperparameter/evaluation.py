@@ -30,11 +30,6 @@ from photon_tpu.optim.regularization import RegularizationType
 DEFAULT_REG_WEIGHT_RANGE = DoubleRange(1e-4, 1e4)
 DEFAULT_REG_ALPHA_RANGE = DoubleRange(0.0, 1.0)
 
-# Floor for log-space weight packing: a grid config trained with lambda=0
-# (regularization present but weight omitted) must still vectorize — the
-# reference's math.log(0) silently yields -Infinity and poisons the GP; we
-# clamp instead.
-_MIN_REG_WEIGHT = 1e-12
 
 
 class EvaluationFunction(Protocol):
@@ -70,6 +65,7 @@ class GameEstimatorEvaluationFunction:
     def __post_init__(self):
         self._coordinate_ids = sorted(self.base_config)
         ranges: list[DoubleRange] = []
+        self._weight_range: dict[str, DoubleRange] = {}
         for cid in self._coordinate_ids:
             cfg = self.base_config[cid]
             raw_range = (
@@ -83,6 +79,7 @@ class GameEstimatorEvaluationFunction:
                     f"start above 0 (weights are searched in log space), "
                     f"got {raw_range.start}"
                 )
+            self._weight_range[cid] = raw_range
             reg_range = raw_range.transform(math.log)
             alpha_range = (
                 DoubleRange(*cfg.elastic_net_param_range)
@@ -144,7 +141,14 @@ class GameEstimatorEvaluationFunction:
         for cid in self._coordinate_ids:
             cfg = configuration[cid]
             t = cfg.regularization.regularization_type
-            w = max(cfg.regularization_weight, _MIN_REG_WEIGHT)
+            # A grid config trained with lambda=0 must still vectorize — the
+            # reference's math.log(0) yields -Infinity and poisons the GP, so
+            # floor at the coordinate's configured range start (a fixed 1e-12
+            # floor would land far outside the unit cube and distort the GP
+            # posterior near the boundary). Above-range weights pass through
+            # unclamped: their true (out-of-cube) location is finite and more
+            # honest to the GP than a relocated boundary observation.
+            w = max(cfg.regularization_weight, self._weight_range[cid].start)
             if t == RegularizationType.ELASTIC_NET:
                 alpha = (
                     1.0 if cfg.regularization.alpha is None
