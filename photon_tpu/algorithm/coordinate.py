@@ -62,6 +62,11 @@ class FixedEffectCoordinate:
 
     batch: GLMBatch
     problem: GLMOptimizationProblem
+    # Canonical row count when ``batch`` carries weight-0 padding rows for
+    # even device sharding (parallel/mesh.py shard_batch): residual vectors
+    # arrive at the canonical length and scores must return at it, so the
+    # coordinate-descent bookkeeping never sees the padding.
+    logical_rows: int | None = None
 
     @property
     def config(self) -> GLMOptimizationConfiguration:
@@ -76,6 +81,9 @@ class FixedEffectCoordinate:
     ):
         batch = self.batch
         if residuals is not None:
+            pad = batch.num_samples - residuals.shape[0]
+            if pad:
+                residuals = jnp.pad(residuals, (0, pad))
             batch = batch.with_offsets(batch.offsets + residuals)
         rate = self.config.down_sampling_rate
         if 0.0 < rate < 1.0:
@@ -90,7 +98,10 @@ class FixedEffectCoordinate:
         return solution.model, solution.result
 
     def score(self, model: GeneralizedLinearModel) -> Array:
-        return model.coefficients.compute_score(self.batch.features)
+        s = model.coefficients.compute_score(self.batch.features)
+        if self.logical_rows is not None and s.shape[0] != self.logical_rows:
+            s = s[: self.logical_rows]
+        return s
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,9 +1,80 @@
-"""Shared CLI plumbing: logging setup with an optional persistent sink."""
+"""Shared CLI plumbing: logging setup, multi-host runtime initialization."""
 
 from __future__ import annotations
 
 import contextlib
 import logging
+
+
+def maybe_init_distributed() -> bool:
+    """Initialize the JAX multi-host runtime when launched under a
+    coordinator (the cluster-session bring-up the reference does in
+    SparkSessionConfiguration.scala:109; here controller-less multi-host:
+    every process calls jax.distributed.initialize and jax.devices() then
+    spans all hosts, so the estimator's auto mesh rides ICI/DCN).
+
+    Uses JAX's own cluster auto-detection (GCE/GKE TPU pods, SLURM, K8s,
+    or the JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID env
+    vars); a plain single-host launch is a no-op. Returns True when
+    initialization ran.
+    """
+    import jax
+
+    if jax.distributed.is_initialized():
+        return False  # idempotent CLI re-entry in one process
+    try:
+        jax.distributed.initialize()
+    except ValueError as e:
+        # Auto-detection found no cluster environment — the normal
+        # single-host case. Any OTHER ValueError (e.g. coordinator set but
+        # num_processes missing) is real misconfiguration: half-configured
+        # pods silently training independent models would be far worse
+        # than failing fast.
+        if "coordinator_address" in str(e):
+            return False
+        raise
+    except RuntimeError as e:
+        # Programmatic re-entry after the XLA backend is already up (tests,
+        # notebooks calling main() mid-session): multi-host init is a
+        # process-start decision, so treat as single-host. Anything else
+        # (real cluster misconfiguration) propagates.
+        if "before any JAX calls" in str(e) or "called once" in str(e):
+            return False
+        raise
+    logging.getLogger("photon.cli").info(
+        "multi-host runtime up: process %d/%d, %d global device(s)",
+        jax.process_index(), jax.process_count(), len(jax.devices()),
+    )
+    return True
+
+
+def fetch_global(x):
+    """Materialize a (possibly host-spanning) device array on this host.
+
+    Multi-host meshes shard rows across processes; fetching such an array
+    with ``np.asarray`` raises (non-addressable shards). Single-host is a
+    plain fetch.
+    """
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns artifact writes (process 0).
+
+    Multi-host SPMD runs execute the same driver on every process; model /
+    score / summary files must be written once (the reference writes from
+    the Spark driver only). Single-host is trivially the coordinator.
+    """
+    import jax
+
+    return jax.process_index() == 0
 
 
 @contextlib.contextmanager

@@ -188,6 +188,10 @@ class TrainingConfig:
     # inputDataDateRange / inputDataDaysRange): "yyyymmdd-yyyymmdd" / "N-M".
     date_range: str | None
     days_range: str | None
+    # Multi-device execution: "auto" (all devices; the reference's
+    # cluster-session default, SparkSessionConfiguration.scala:109), "off",
+    # or a device count.
+    mesh: str | int = "auto"
 
     @staticmethod
     def load(path: str) -> "TrainingConfig":
@@ -225,6 +229,7 @@ class TrainingConfig:
             id_columns=raw.get("input", {}).get("id_columns"),
             date_range=raw.get("input", {}).get("date_range"),
             days_range=raw.get("input", {}).get("days_range"),
+            mesh=raw.get("mesh", "auto"),
         )
 
     def opt_config_sequence(self) -> list[dict[str, GLMOptimizationConfiguration]]:
@@ -250,6 +255,7 @@ class TrainingConfig:
             evaluators=self.evaluators or None,
             locked_coordinates=self.locked_coordinates,
             incremental_training=self.incremental_training,
+            mesh=self.mesh,
         )
 
 

@@ -40,6 +40,9 @@ def main(argv=None) -> int:
                         help="top-level record fields to expose as id tags")
     parser.add_argument("--data-validation", default="DISABLED",
                         help="FULL | SAMPLE | DISABLED")
+    parser.add_argument("--mesh", default="auto",
+                        help="multi-device scoring: auto (all devices), "
+                             "off, or a device count")
     parser.add_argument("--backend", default=None)
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("--log-file", default=None,
@@ -49,9 +52,10 @@ def main(argv=None) -> int:
 
     if args.backend:
         os.environ["JAX_PLATFORMS"] = args.backend
-    from photon_tpu.cli.common import cli_logging
+    from photon_tpu.cli.common import cli_logging, maybe_init_distributed
 
     with cli_logging(args.verbose, args.log_file):
+        maybe_init_distributed()
         return _run(args)
 
 
@@ -123,11 +127,22 @@ def _run(args) -> int:
     sanity_check_data(
         data, model.task, args.data_validation, check_labels=False,
     )
-    transformer = GameTransformer(model)
+    from photon_tpu.parallel.mesh import resolve_mesh
+
+    transformer = GameTransformer(model, mesh=resolve_mesh(args.mesh))
     scores, evaluation = transformer.transform(
         data, evaluators=args.evaluators
     )
 
+    from photon_tpu.cli.common import fetch_global, is_coordinator
+
+    # Sharded scores span hosts in a multi-host run: gather BEFORE the
+    # coordinator gate (allgather is a collective — every process must
+    # participate or the coordinator deadlocks).
+    scores = fetch_global(scores)
+    if not is_coordinator():
+        # Artifacts are written once, from process 0.
+        return 0
     os.makedirs(args.output, exist_ok=True)
     save_scores(
         os.path.join(args.output, "part-00000.avro"),

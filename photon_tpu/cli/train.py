@@ -38,9 +38,10 @@ def main(argv=None) -> int:
 
     if args.backend:
         os.environ["JAX_PLATFORMS"] = args.backend
-    from photon_tpu.cli.common import cli_logging
+    from photon_tpu.cli.common import cli_logging, maybe_init_distributed
 
     with cli_logging(args.verbose, args.log_file):
+        maybe_init_distributed()
         return _run(args)
 
 
@@ -346,6 +347,13 @@ def _run(args) -> int:
             for cid, c in r.config.items()
         }
 
+    # Multi-host runs execute this driver on every process (the compute —
+    # fit, tuning, scoring — is SPMD and must run everywhere), but artifact
+    # writes happen once, from process 0 (the reference writes from the
+    # Spark driver only).
+    from photon_tpu.cli.common import is_coordinator
+
+    write_outputs = is_coordinator()
     summary = {
         "task": cfg.task.value,
         "num_training_rows": train.num_samples,
@@ -362,8 +370,11 @@ def _run(args) -> int:
         ],
         "wall_clock_seconds": round(time.time() - t_start, 2),
     }
-    with open(os.path.join(cfg.output_dir, "training-summary.json"), "w") as f:
-        json.dump(summary, f, indent=2)
+    if write_outputs:
+        with open(
+            os.path.join(cfg.output_dir, "training-summary.json"), "w"
+        ) as f:
+            json.dump(summary, f, indent=2)
 
     # Model output modes (io/ModelOutputMode.scala:47): NONE saves nothing;
     # BEST the selected model; EXPLICIT adds the lambda-grid models; TUNED
@@ -388,17 +399,18 @@ def _run(args) -> int:
         to_save = list(enumerate(results))
     else:
         raise ValueError(f"unknown model_output_mode {mode!r}")
-    for i, r in to_save:
-        subdir = "best" if r is best else f"config_{i}"
-        out = os.path.join(cfg.output_dir, "models", subdir)
-        save_game_model(
-            r.model, out, index_maps,
-            task=cfg.task,
-            optimization_configurations=config_json(r),
-        )
-        save_checkpoint(r.model, os.path.join(out, "checkpoint.npz"))
-    log.info("saved %d model(s) to %s", len(to_save),
-             os.path.join(cfg.output_dir, "models"))
+    if write_outputs:
+        for i, r in to_save:
+            subdir = "best" if r is best else f"config_{i}"
+            out = os.path.join(cfg.output_dir, "models", subdir)
+            save_game_model(
+                r.model, out, index_maps,
+                task=cfg.task,
+                optimization_configurations=config_json(r),
+            )
+            save_checkpoint(r.model, os.path.join(out, "checkpoint.npz"))
+        log.info("saved %d model(s) to %s", len(to_save),
+                 os.path.join(cfg.output_dir, "models"))
 
     # ------------------------------------------------------------------
     # per-group evaluation output (savePerGroupEvaluationToHDFS :878-901)
@@ -420,8 +432,14 @@ def _run(args) -> int:
             group_ids=group_ids, dtype=validation.labels.dtype,
         )
         for i, r in to_save:
-            scores = GameTransformer(r.model).score(validation)
+            # Scoring is SPMD compute: every process participates; only
+            # the file writes below are coordinator-gated.
+            scores = GameTransformer(
+                r.model, mesh=estimator.resolve_mesh()
+            ).score(validation)
             per_group = suite.evaluate_per_group(scores)
+            if not write_outputs:
+                continue
             out_dir = os.path.join(
                 cfg.output_dir, "group-evaluation", str(i))
             os.makedirs(out_dir, exist_ok=True)
@@ -437,13 +455,15 @@ def _run(args) -> int:
                 with open(os.path.join(out_dir, fname), "w") as f:
                     json.dump(payload, f, indent=2)
         log.info("wrote per-group evaluations for %d model(s)", len(to_save))
-    print(json.dumps({
-        "best_configuration": config_json(best),
-        "evaluation":
-            None if best.evaluation is None else best.evaluation.evaluations,
-        "output_dir": cfg.output_dir,
-        "wall_clock_seconds": summary["wall_clock_seconds"],
-    }))
+    if write_outputs:
+        print(json.dumps({
+            "best_configuration": config_json(best),
+            "evaluation":
+                None if best.evaluation is None
+                else best.evaluation.evaluations,
+            "output_dir": cfg.output_dir,
+            "wall_clock_seconds": summary["wall_clock_seconds"],
+        }))
     return 0
 
 

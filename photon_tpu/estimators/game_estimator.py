@@ -51,6 +51,11 @@ from photon_tpu.models.game import (
     remap_random_effect_model,
 )
 from photon_tpu.ops.normalization import NormalizationContext
+from photon_tpu.parallel.mesh import (
+    resolve_mesh,
+    shard_batch,
+    shard_random_effect_dataset,
+)
 from photon_tpu.types import TaskType
 
 Array = jax.Array
@@ -142,6 +147,7 @@ class GameEstimator:
         evaluators: list[str | EvaluatorSpec] | None = None,
         locked_coordinates: set[str] | None = None,
         incremental_training: bool = False,
+        mesh="auto",
     ):
         self.task = task
         self.coordinate_configs = dict(coordinate_configs)
@@ -162,6 +168,20 @@ class GameEstimator:
         # Gaussian prior (GameEstimator.scala incrementalTraining param;
         # invariants validated at fit time, :241-382).
         self.incremental_training = incremental_training
+        # Multi-device execution. The reference's drivers are distributed by
+        # default — GameTrainingDriver.run executes on the cluster session
+        # (SparkSessionConfiguration.scala:109) — so "auto" spans all visible
+        # devices: fixed-effect batches are row-sharded (dp) and
+        # random-effect entity axes are sharded (ep) over a one-axis mesh.
+        # Pass "off"/None for single-device, or a jax.sharding.Mesh / device
+        # count to control placement explicitly.
+        self.mesh = mesh
+
+    def resolve_mesh(self):
+        """mesh param -> Mesh | None (resolved once; devices don't change)."""
+        if not hasattr(self, "_resolved_mesh"):
+            self._resolved_mesh = resolve_mesh(self.mesh)
+        return self._resolved_mesh
 
     # ------------------------------------------------------------------
     # dataset / coordinate construction (prepareTrainingDatasets + factory)
@@ -178,7 +198,15 @@ class GameEstimator:
         A prior model's per-entity feature support is unioned into the
         subspace projectors (RandomEffectDataset.scala:390-426) so its
         coefficients keep their slots under warm start.
+
+        With a mesh, fixed-effect batches are padded and row-sharded (dp)
+        and random-effect entity axes sharded (ep) — the product-surface
+        analog of GameTrainingDriver running on the cluster session
+        (GameTrainingDriver.scala:363-516).
         """
+        from photon_tpu.data.dataset import DualEllFeatures
+
+        mesh = self.resolve_mesh()
         out: dict[str, object] = {}
         for cid, cfg in self.coordinate_configs.items():
             if isinstance(cfg, RandomEffectCoordinateConfiguration):
@@ -196,7 +224,7 @@ class GameEstimator:
                             if code is not None:
                                 p = prior.proj_all[eo]
                                 extra[code] = p[p >= 0]
-                out[cid] = build_random_effect_dataset(
+                ds = build_random_effect_dataset(
                     data,
                     cfg.data,
                     intercept_index=self.intercept_indices.get(
@@ -204,8 +232,19 @@ class GameEstimator:
                     ),
                     extra_features=extra,
                 )
+                if mesh is not None:
+                    ds = shard_random_effect_dataset(ds, mesh)
+                out[cid] = ds
             else:
-                out[cid] = data.shard_batch(cfg.feature_shard_id)
+                batch = data.shard_batch(cfg.feature_shard_id)
+                if mesh is not None:
+                    if isinstance(batch.features, DualEllFeatures):
+                        logger.info(
+                            "coordinate %s: DualEll features are not "
+                            "row-shardable; leaving replicated", cid)
+                    else:
+                        batch = shard_batch(batch, mesh)
+                out[cid] = batch
         return out
 
     def _build_coordinates(
@@ -213,6 +252,7 @@ class GameEstimator:
         datasets: dict[str, object],
         opt_configs: dict[str, GLMOptimizationConfiguration],
         priors: dict[str, object] | None = None,
+        logical_rows: int | None = None,
     ) -> dict[str, object]:
         """CoordinateFactory.build equivalent (CoordinateFactory.scala:52);
         ``priors`` carries incremental-training prior models per coordinate
@@ -240,7 +280,9 @@ class GameEstimator:
                     prior=priors.get(cid),
                 )
                 coords[cid] = _FixedEffectModelAdapter(
-                    FixedEffectCoordinate(datasets[cid], problem),
+                    FixedEffectCoordinate(
+                        datasets[cid], problem, logical_rows=logical_rows
+                    ),
                     cfg.feature_shard_id,
                 )
         return coords
@@ -250,7 +292,12 @@ class GameEstimator:
         datasets: dict[str, object],
         validation: GameDataset,
     ) -> ValidationContext:
-        """prepareValidationDatasetAndEvaluators equivalent (:649-673)."""
+        """prepareValidationDatasetAndEvaluators equivalent (:649-673).
+
+        Validation scorers ride the same mesh as training: the remapped
+        score tables are row-sharded, so per-CD-iteration validation
+        scoring scales with the device count too."""
+        mesh = self.resolve_mesh()
         specs = list(self.evaluators) or [_DEFAULT_EVALUATOR[self.task]]
         group_ids = {
             name: (tag.codes, tag.num_groups)
@@ -275,10 +322,11 @@ class GameEstimator:
                     entity_keys=ds.entity_keys,
                     proj_all=ds.proj_all,
                     width_cap=cfg.data.score_table_width_cap,
+                    mesh=mesh,
                 )
             else:
                 scorers[cid] = fixed_effect_scorer(
-                    validation, cfg.feature_shard_id
+                    validation, cfg.feature_shard_id, mesh
                 )
         return ValidationContext(suite=suite, scorers=scorers)
 
@@ -376,7 +424,10 @@ class GameEstimator:
         results: list[GameFitResult] = []
         prev_model: GameModel | None = initial_model
         for i, opt_configs in enumerate(opt_config_sequence):
-            coords = self._build_coordinates(datasets, opt_configs, priors)
+            coords = self._build_coordinates(
+                datasets, opt_configs, priors,
+                logical_rows=data.num_samples,
+            )
             cd = CoordinateDescent(
                 self.update_sequence,
                 self.num_iterations,

@@ -143,12 +143,15 @@ class GameEstimatorEvaluationFunction:
             t = cfg.regularization.regularization_type
             # A grid config trained with lambda=0 must still vectorize — the
             # reference's math.log(0) yields -Infinity and poisons the GP, so
-            # floor at the coordinate's configured range start (a fixed 1e-12
-            # floor would land far outside the unit cube and distort the GP
-            # posterior near the boundary). Above-range weights pass through
-            # unclamped: their true (out-of-cube) location is finite and more
-            # honest to the GP than a relocated boundary observation.
-            w = max(cfg.regularization_weight, self._weight_range[cid].start)
+            # zero maps to the coordinate's configured range start (a fixed
+            # 1e-12 floor would land far outside the unit cube and distort
+            # the GP posterior near the boundary). Positive out-of-range
+            # weights pass through unclamped: their true (out-of-cube)
+            # location is finite and more honest to the GP than a relocated
+            # boundary observation.
+            w = cfg.regularization_weight
+            if w <= 0.0:
+                w = self._weight_range[cid].start
             if t == RegularizationType.ELASTIC_NET:
                 alpha = (
                     1.0 if cfg.regularization.alpha is None

@@ -102,9 +102,64 @@ def make_mesh(
     return Mesh(devs, (axis_name,))
 
 
+def resolve_mesh(setting) -> Mesh | None:
+    """Shared mesh-setting resolution for the estimator and the CLIs.
+
+    ``"auto"`` -> all devices (None when single-device), ``"off"``/``None``/
+    ``False``/``1`` -> None, an int or digit string -> that many devices, a
+    ``Mesh`` -> itself. Unrecognized strings raise — a typo like ``"fof"``
+    must not silently mean "auto".
+    """
+    m = setting
+    if isinstance(m, str):
+        key = m.lower()
+        if key == "auto":
+            return make_mesh() if len(jax.devices()) > 1 else None
+        if key in ("off", "none", "1"):
+            return None
+        if key.isdigit():
+            m = int(key)
+        else:
+            raise ValueError(f"unknown mesh setting {setting!r}")
+    if isinstance(m, bool):
+        return make_mesh() if (m and len(jax.devices()) > 1) else None
+    if isinstance(m, int):
+        if m < 1:
+            raise ValueError(f"mesh setting must be >= 1 device, got {m}")
+        if m > len(jax.devices()):
+            raise ValueError(
+                f"mesh setting requests {m} devices but only "
+                f"{len(jax.devices())} are visible")
+        return make_mesh(jax.devices()[:m]) if m > 1 else None
+    if m is None or isinstance(m, Mesh):
+        return m
+    raise TypeError(f"unknown mesh setting {setting!r}")
+
+
 def row_sharding(mesh: Mesh, ndim: int, *, axis_name: str = DATA_AXIS) -> NamedSharding:
     """Shard the leading (row) axis, replicate the rest."""
     return NamedSharding(mesh, P(axis_name, *([None] * (ndim - 1))))
+
+
+def maybe_row_shard(mesh: Mesh | None, *leaves):
+    """Place [n, ...] leaves row-sharded over the mesh's leading axis when n
+    divides its extent evenly; otherwise return them unchanged.
+
+    The shared no-padding placement policy for one-pass tables (batch
+    scoring, score tables): the per-row work is identical either way, only
+    the placement changes, so padding machinery isn't worth it here.
+    """
+    if mesh is None:
+        return leaves
+    axis = mesh.axis_names[0]
+    if leaves[0].shape[0] % mesh.shape[axis]:
+        return leaves
+    return tuple(
+        jax.device_put(
+            leaf, row_sharding(mesh, np.ndim(leaf), axis_name=axis)
+        )
+        for leaf in leaves
+    )
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

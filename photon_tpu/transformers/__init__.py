@@ -24,13 +24,24 @@ from photon_tpu.models.game import (
     RandomEffectModel,
     score_entity_table_with_tail,
 )
+from photon_tpu.parallel.mesh import maybe_row_shard
 
 Array = jax.Array
 
 
-def fixed_effect_scorer(data: GameDataset, feature_shard_id: str):
+def fixed_effect_scorer(data: GameDataset, feature_shard_id: str, mesh=None):
     """model -> per-row scores for a fixed-effect sub-model on ``data``."""
+    from photon_tpu.data.dataset import DenseFeatures, SparseFeatures
+
     feats = data.feature_shards[feature_shard_id]
+    if mesh is not None:
+        if isinstance(feats, DenseFeatures):
+            feats = DenseFeatures(*maybe_row_shard(mesh, feats.x))
+        elif isinstance(feats, SparseFeatures):
+            feats = SparseFeatures(
+                *maybe_row_shard(mesh, feats.indices, feats.values), feats.d
+            )
+        # DualEll tables stay replicated: the COO tail is not row-aligned.
 
     def scorer(m: FixedEffectModel) -> Array:
         return m.model.coefficients.compute_score(feats)
@@ -46,12 +57,15 @@ def random_effect_scorer(
     entity_keys: tuple,
     proj_all,
     width_cap: int | None = None,
+    mesh=None,
 ):
     """model -> per-row scores for a random-effect sub-model on ``data``.
 
     The expensive host-side subspace remap happens once at construction;
     the returned closure is a pure device gather. ``width_cap`` bounds the
-    remapped table's slab width (overflow rides a COO tail).
+    remapped table's slab width (overflow rides a COO tail). With ``mesh``
+    the remapped table is row-sharded; the COO tail stays replicated (its
+    segment-sum spans rows across shards).
     """
     codes, idx, vals, tail = remap_for_scoring(
         data,
@@ -61,6 +75,7 @@ def random_effect_scorer(
         proj_all=proj_all,
         width_cap=width_cap,
     )
+    codes, idx, vals = maybe_row_shard(mesh, codes, idx, vals)
 
     def scorer(m: RandomEffectModel) -> Array:
         return score_entity_table_with_tail(
@@ -71,7 +86,7 @@ def random_effect_scorer(
 
 
 def make_submodel_scorer(sub_model, data: GameDataset,
-                         width_cap: int | None = None):
+                         width_cap: int | None = None, mesh=None):
     """Dispatch a scorer for one trained sub-model (GameModel.score arm)."""
     if isinstance(sub_model, RandomEffectModel):
         return random_effect_scorer(
@@ -81,9 +96,10 @@ def make_submodel_scorer(sub_model, data: GameDataset,
             entity_keys=sub_model.entity_keys,
             proj_all=sub_model.proj_all,
             width_cap=width_cap,
+            mesh=mesh,
         )
     if isinstance(sub_model, FixedEffectModel):
-        return fixed_effect_scorer(data, sub_model.feature_shard_id)
+        return fixed_effect_scorer(data, sub_model.feature_shard_id, mesh)
     raise TypeError(f"unknown sub-model type: {sub_model}")
 
 
@@ -92,6 +108,10 @@ class GameTransformer:
     """Reference: transformers/GameTransformer.scala (transform :150-197)."""
 
     model: GameModel
+    # Optional jax.sharding.Mesh: score tables are placed row-sharded (the
+    # batch-scoring twin of the estimator's dp path; GameScoringDriver runs
+    # on the cluster session like the training driver).
+    mesh: object = None
 
     def score(self, data: GameDataset) -> Array:
         """Summed sub-model scores per row — the raw model contribution, no
@@ -99,7 +119,7 @@ class GameTransformer:
         and by downstream consumers, EvaluationSuite.scala:62-66)."""
         total = None
         for _, m in self.model.items():
-            s = make_submodel_scorer(m, data)(m)
+            s = make_submodel_scorer(m, data, mesh=self.mesh)(m)
             total = s if total is None else total + s
         if total is None:
             raise ValueError("empty GAME model")
