@@ -43,8 +43,6 @@ CD_ITERATIONS = 2
 
 
 def build_data():
-    import jax.numpy as jnp
-
     from photon_tpu.data.dataset import DenseFeatures
     from photon_tpu.data.game_data import make_game_dataset
 
@@ -66,12 +64,14 @@ def build_data():
         + np.einsum("nd,nd->n", xm, wm[movies])
         + 0.2 * rng.normal(size=N_ROWS).astype(np.float32)
     )
+    # Numpy-backed shards: make_game_dataset pushes the device copy once and
+    # keeps host mirrors for the (host-side) dataset-build planner.
     return make_game_dataset(
         y,
         {
-            "global": DenseFeatures(jnp.asarray(x)),
-            "userShard": DenseFeatures(jnp.asarray(xu)),
-            "movieShard": DenseFeatures(jnp.asarray(xm)),
+            "global": DenseFeatures(x),
+            "userShard": DenseFeatures(xu),
+            "movieShard": DenseFeatures(xm),
         },
         id_tags={"userId": users, "movieId": movies},
     )

@@ -37,7 +37,11 @@ from photon_tpu.algorithm.problems import (
     variances_in_transformed_space,
 )
 from photon_tpu.data.dataset import GLMBatch, SparseFeatures
-from photon_tpu.data.random_effect import EntityBlocks, RandomEffectDataset
+from photon_tpu.data.random_effect import (
+    BlockPlan,
+    EntityBlocks,
+    RandomEffectDataset,
+)
 from photon_tpu.models.game import RandomEffectModel
 from photon_tpu.ops import glm as glm_ops
 from photon_tpu.ops import losses as losses_mod
@@ -289,15 +293,15 @@ def _solve_one_entity(
     ),
 )
 def _solve_block(
-    block: EntityBlocks,
-    offsets: Array,  # [B, R] effective offsets (base + residuals)
-    factors_sub: Array,  # [B, S]
-    shifts_sub: Array,  # [B, S]
-    w0: Array,  # [B, S] original-space warm starts
+    block,  # EntityBlocks | BlockPlan (pytree structure selects the path)
+    residuals: Array | None,  # [n] canonical residual scores, or None
+    factors_full: Array | None,  # [d] global normalization factors
+    shifts_full: Array | None,  # [d] global normalization shifts
+    w0_full: Array | None,  # [E, Smax] original-space warm starts
     l1_weight: Array,
     l2_weight: Array,
     incremental_weight: Array,
-    prior: tuple[Array, Array] | None,  # ([B, S], [B, S]) or None
+    prior_full: tuple[Array, Array] | None,  # ([E, Smax], [E, Smax]) or None
     *,
     sub_dim: int,
     task: TaskType,
@@ -306,6 +310,55 @@ def _solve_block(
     variance_computation: VarianceComputationType,
     direct: bool = False,
 ):
+    """One bucket's batched per-entity solve (everything traced/fused).
+
+    Lazy ``BlockPlan`` buckets materialize their [B, R, k] slabs here, INSIDE
+    the compiled program, by gathering the HBM-resident raw arrays — the
+    slabs never exist on the host (data/random_effect.py module docstring).
+    Warm-start / prior / normalization gathers are also traced, so one fit
+    dispatches a single device program per bucket.
+    """
+    if isinstance(block, BlockPlan):
+        block = block.materialize(residuals)
+        offsets = block.offsets
+    else:
+        offsets = block.offsets
+        if residuals is not None:
+            # Padding rows alias canonical row 0; mask their gather.
+            offsets = offsets + jnp.where(
+                block.weights > 0,
+                jnp.take(residuals, block.row_ids, mode="clip"),
+                0.0,
+            )
+    dtype = block.x_values.dtype
+    s = sub_dim
+    codes = block.entity_codes
+    proj = block.proj  # [B, S]; -1 pad
+    safe = jnp.maximum(proj, 0)
+    factors_sub = shifts_sub = None
+    if factors_full is not None:
+        f = jnp.take(factors_full.astype(dtype), safe, mode="clip")
+        factors_sub = jnp.where(proj >= 0, f, 1.0)
+    if shifts_full is not None:
+        sh = jnp.take(shifts_full.astype(dtype), safe, mode="clip")
+        shifts_sub = jnp.where(proj >= 0, sh, 0.0)
+    if w0_full is not None:
+        # Sentinel codes (mesh entity padding) clip to the last row; their
+        # results are dropped by the out-of-bounds scatter on the way back.
+        w0 = jnp.take(w0_full.astype(dtype), codes, axis=0, mode="clip")
+        w0 = w0[:, :s]
+    else:
+        w0 = jnp.zeros((block.num_entities, s), dtype)
+    prior = None
+    if prior_full is not None:
+        prior = (
+            jnp.take(
+                prior_full[0].astype(dtype), codes, axis=0, mode="clip"
+            )[:, :s],
+            jnp.take(
+                prior_full[1].astype(dtype), codes, axis=0, mode="clip"
+            )[:, :s],
+        )
     if direct:
         def direct_solver(xi, xv, lb, off, wt, pm, vm, f, sh, islot, prior_e):
             return _solve_one_entity_direct(
@@ -380,22 +433,6 @@ class RandomEffectCoordinate:
     # (RandomEffectOptimizationProblem.scala:137-198 projected priors).
     prior: RandomEffectModel | None = None
 
-    def _projected_norms(self, block: EntityBlocks, dtype):
-        """Gather the global factor/shift vectors through each entity's
-        projector (RandomEffectOptimizationProblem projected contexts).
-        None (not materialized ones/zeros) when no normalization is set, so
-        the jitted solver specializes to the raw fast path."""
-        proj = block.proj  # [B, S]; -1 pad
-        safe = jnp.maximum(proj, 0)
-        f = s = None
-        if self.normalization.factors is not None:
-            f = jnp.take(self.normalization.factors.astype(dtype), safe)
-            f = jnp.where(proj >= 0, f, 1.0)
-        if self.normalization.shifts is not None:
-            s = jnp.take(self.normalization.shifts.astype(dtype), safe)
-            s = jnp.where(proj >= 0, s, 0.0)
-        return f, s
-
     def train(
         self,
         residuals: Array | None = None,
@@ -404,7 +441,7 @@ class RandomEffectCoordinate:
         seed: int = 0,
     ) -> tuple[RandomEffectModel, RandomEffectTrainingStats]:
         ds = self.dataset
-        dtype = ds.score_values.dtype
+        dtype = jnp.dtype(ds.dtype)
         w_all = jnp.zeros((ds.num_entities, ds.max_sub_dim), dtype)
         v_all = (
             jnp.zeros((ds.num_entities, ds.max_sub_dim), dtype)
@@ -415,58 +452,29 @@ class RandomEffectCoordinate:
         # two coalesced transfers after all blocks are dispatched.
         reasons: list[tuple[Array, np.ndarray]] = []
         iters: list[Array] = []
-        real_masks = [ds.real_entity_mask(b) for b in ds.blocks]
+        real_masks = [
+            ds.real_entity_mask(i) for i in range(len(ds.blocks))
+        ]
 
         if self.normalization.shifts is not None:
             # Shift normalization folds the shift mass into the intercept on
             # the coefficient round trip; every trained entity must have one
             # (the per-entity analog of NormalizationContext.__post_init__).
-            for block, real in zip(ds.blocks, real_masks):
-                if bool(
-                    (np.asarray(block.intercept_slots)[real] < 0).any()
-                ):
+            for ints, real in zip(ds.block_intercepts_np, real_masks):
+                if bool((np.asarray(ints)[real] < 0).any()):
                     raise ValueError(
                         "normalization with shifts requires every entity's "
                         "subspace to contain the intercept; build the "
                         "dataset with intercept_index set"
                     )
 
+        if self.prior is not None and self.prior.variances is None:
+            raise ValueError(
+                "incremental training requires prior variances for "
+                "every entity model (GameEstimator.scala:241-382)")
+
         for block, real in zip(ds.blocks, real_masks):
             s = block.sub_dim
-            offsets = block.offsets
-            if residuals is not None:
-                # Padding rows alias canonical row 0; mask their gather.
-                offsets = offsets + jnp.where(
-                    block.weights > 0, jnp.take(residuals, block.row_ids), 0.0
-                )
-            f, sh = self._projected_norms(block, dtype)
-            if initial_model is not None:
-                # Warm start assumes the initial model shares this dataset's
-                # projector layout (true across CD iterations and lambda
-                # configs; external models are remapped by the estimator).
-                w0 = jnp.take(
-                    initial_model.coefficients.astype(dtype),
-                    block.entity_codes,
-                    axis=0,
-                )[:, :s]
-            else:
-                w0 = jnp.zeros((block.num_entities, s), dtype)
-            prior = None
-            if self.prior is not None:
-                if self.prior.variances is None:
-                    raise ValueError(
-                        "incremental training requires prior variances for "
-                        "every entity model (GameEstimator.scala:241-382)")
-                prior = (
-                    jnp.take(
-                        self.prior.coefficients.astype(dtype),
-                        block.entity_codes, axis=0,
-                    )[:, :s],
-                    jnp.take(
-                        self.prior.variances.astype(dtype),
-                        block.entity_codes, axis=0,
-                    )[:, :s],
-                )
             # Squared-loss subproblems are convex quadratics: solve them
             # exactly with one batched Cholesky instead of iterating
             # (identical optimum, ~100x fewer sequential device steps).
@@ -488,14 +496,16 @@ class RandomEffectCoordinate:
             )
             w, v, it, reason = _solve_block(
                 block,
-                offsets,
-                f,
-                sh,
-                w0,
+                residuals,
+                self.normalization.factors,
+                self.normalization.shifts,
+                None if initial_model is None
+                else initial_model.coefficients,
                 jnp.asarray(self.config.l1_weight, dtype=dtype),
                 jnp.asarray(self.config.l2_weight, dtype=dtype),
                 jnp.asarray(self.config.incremental_weight, dtype=dtype),
-                prior,
+                None if self.prior is None
+                else (self.prior.coefficients, self.prior.variances),
                 sub_dim=s,
                 task=self.task,
                 opt_config=self.config.optimizer,

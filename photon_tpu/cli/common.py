@@ -60,6 +60,14 @@ def fetch_global(x):
 
     if jax.process_count() == 1:
         return np.asarray(x)
+    # Process-local (fully addressable) or replicated arrays already carry
+    # the complete value on this host: allgathering them would concatenate
+    # one full copy per process (duplicated rows in the written output).
+    # Only arrays genuinely sharded across hosts need the gather.
+    if getattr(x, "is_fully_addressable", True) or getattr(
+        x, "is_fully_replicated", False
+    ):
+        return np.asarray(x)
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))

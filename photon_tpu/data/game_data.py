@@ -21,7 +21,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_tpu.data.dataset import Features, GLMBatch
+from photon_tpu.data.dataset import (
+    DenseFeatures,
+    DualEllFeatures,
+    Features,
+    GLMBatch,
+    SparseFeatures,
+)
 
 Array = jax.Array
 
@@ -33,10 +39,19 @@ class IdTag:
     codes: Array  # [n] int32
     vocab: dict  # str key -> code
     inverse: tuple  # code -> str key
+    # Host mirror of ``codes``: the ingest planner (entity grouping,
+    # reservoir sampling) is host-side numpy; keeping the codes it was built
+    # from avoids a device->host round trip per dataset build.
+    codes_np: np.ndarray | None = None
 
     @property
     def num_groups(self) -> int:
         return len(self.inverse)
+
+    def host_codes(self) -> np.ndarray:
+        if self.codes_np is not None:
+            return self.codes_np
+        return np.asarray(self.codes)
 
     @staticmethod
     def from_raw(raw_ids) -> "IdTag":
@@ -53,10 +68,12 @@ class IdTag:
             raise ValueError(
                 "id tag keys collide after str normalization"
             )
+        codes = codes.astype(np.int32)
         return IdTag(
-            codes=jnp.asarray(codes.astype(np.int32)),
+            codes=jnp.asarray(codes),
             vocab={k: i for i, k in enumerate(keys)},
             inverse=keys,
+            codes_np=codes,
         )
 
 
@@ -70,10 +87,77 @@ class GameDataset:
     feature_shards: dict[str, Features]
     id_tags: dict[str, IdTag]
     uids: np.ndarray | None = None  # host-side original row ids, optional
+    # Host numpy mirrors captured at ingest (``make_game_dataset`` stashes
+    # the numpy inputs before pushing them to the device). The dataset-build
+    # planner works entirely on these, so ingest never pulls device arrays
+    # back over the (potentially slow) host<->device link. Keys:
+    # "labels"/"offsets"/"weights" -> [n] arrays; shard names -> the host
+    # view returned by ``host_shard_coo``.
+    host: dict | None = None
 
     @property
     def num_samples(self) -> int:
         return int(self.labels.shape[0])
+
+    def host_column(self, name: str) -> np.ndarray:
+        """Host view of labels/offsets/weights (mirror or cached pull)."""
+        if self.host is not None and name in self.host:
+            return self.host[name]
+        view = np.asarray(getattr(self, name))
+        if self.host is not None:
+            self.host[name] = view
+        return view
+
+    def host_shard_coo(self, shard_id: str):
+        """Host-side ``(indices [n, k], values [n, k], d)`` ELL view of a
+        feature shard, preferring the ingest-time mirror. Computed views are
+        cached into the mirror dict so repeated planning passes pull the
+        device data at most once.
+
+        For ``DualEllFeatures`` this is the bounded-width SLAB only — the
+        overflow entries live in ``host_shard_tail`` (re-widening the slab
+        to the widest row would reintroduce exactly the memory hazard the
+        dual-ELL layout bounds, SURVEY §7.3)."""
+        if self.host is not None and shard_id in self.host:
+            return self.host[shard_id]
+        feats = self.feature_shards[shard_id]
+        if isinstance(feats, DenseFeatures):
+            x = np.asarray(feats.x)
+            n, d = x.shape
+            idx = np.broadcast_to(np.arange(d, dtype=np.int32), (n, d))
+            view = (idx, x, d)
+        elif isinstance(feats, (SparseFeatures, DualEllFeatures)):
+            view = (
+                np.asarray(feats.indices), np.asarray(feats.values), feats.d
+            )
+        else:
+            raise TypeError(
+                f"shard {shard_id!r}: no host COO view for "
+                f"{type(feats).__name__}"
+            )
+        if self.host is not None:
+            self.host[shard_id] = view
+        return view
+
+    def host_shard_tail(self, shard_id: str):
+        """Host ``(rows, indices, values)`` COO overflow of a DualEll shard
+        (rows sorted ascending), or None for rectangular layouts."""
+        feats = self.feature_shards[shard_id]
+        if not isinstance(feats, DualEllFeatures):
+            return None
+        key = (shard_id, "__tail__")
+        if self.host is not None and key in self.host:
+            return self.host[key]
+        tail = (
+            np.asarray(feats.tail_rows),
+            np.asarray(feats.tail_indices),
+            np.asarray(feats.tail_values),
+        )
+        if tail[0].size == 0:
+            tail = None
+        if self.host is not None:
+            self.host[key] = tail
+        return tail
 
     def shard_batch(self, shard_id: str) -> GLMBatch:
         """A GLMBatch view for one feature shard (FixedEffectDataset
@@ -100,20 +184,53 @@ def make_game_dataset(
     uids=None,
     dtype=jnp.float32,
 ) -> GameDataset:
-    labels = jnp.asarray(np.asarray(labels), dtype=dtype)
-    n = labels.shape[0]
+    np_dtype = np.dtype(dtype)
+    labels_np = np.asarray(labels, dtype=np_dtype)
+    n = labels_np.shape[0]
+    offsets_np = (
+        np.zeros(n, np_dtype) if offsets is None
+        else np.asarray(offsets, dtype=np_dtype)
+    )
+    weights_np = (
+        np.ones(n, np_dtype) if weights is None
+        else np.asarray(weights, dtype=np_dtype)
+    )
+    host: dict = {
+        "labels": labels_np, "offsets": offsets_np, "weights": weights_np,
+    }
+    # Feature shards may arrive with host numpy arrays inside (the cheap way
+    # to ingest: the dataset build plans on the numpy mirror and the device
+    # copy is pushed exactly once, here). Device-backed shards pass through
+    # untouched (no mirror; host views fall back to a one-time pull).
+    shards: dict[str, Features] = {}
     for name, feats in feature_shards.items():
         rows = (feats.x.shape[0] if hasattr(feats, "x") else feats.indices.shape[0])
         if rows != n:
             raise ValueError(
                 f"feature shard {name!r} has {rows} rows, expected {n}")
+        if isinstance(feats, DenseFeatures) and isinstance(feats.x, np.ndarray):
+            x = np.asarray(feats.x, dtype=np_dtype)
+            d = x.shape[1]
+            host[name] = (
+                np.broadcast_to(np.arange(d, dtype=np.int32), x.shape), x, d,
+            )
+            feats = DenseFeatures(jnp.asarray(x))
+        elif isinstance(feats, SparseFeatures) and isinstance(
+            feats.indices, np.ndarray
+        ):
+            idx = np.asarray(feats.indices, dtype=np.int32)
+            val = np.asarray(feats.values, dtype=np_dtype)
+            host[name] = (idx, val, feats.d)
+            feats = SparseFeatures(
+                jnp.asarray(idx), jnp.asarray(val), feats.d
+            )
+        shards[name] = feats
     return GameDataset(
-        labels=labels,
-        offsets=(jnp.zeros(n, dtype) if offsets is None
-                 else jnp.asarray(np.asarray(offsets), dtype)),
-        weights=(jnp.ones(n, dtype) if weights is None
-                 else jnp.asarray(np.asarray(weights), dtype)),
-        feature_shards=dict(feature_shards),
+        labels=jnp.asarray(labels_np),
+        offsets=jnp.asarray(offsets_np),
+        weights=jnp.asarray(weights_np),
+        feature_shards=shards,
         id_tags={k: IdTag.from_raw(v) for k, v in (id_tags or {}).items()},
         uids=None if uids is None else np.asarray(uids),
+        host=host,
     )

@@ -1,4 +1,4 @@
-"""RandomEffectDataset: per-entity data as size-bucketed padded device blocks.
+"""RandomEffectDataset: per-entity data as size-bucketed device blocks.
 
 TPU-native counterpart of the heart of GLMix scaling (photon-api
 data/RandomEffectDataset.scala:54, apply :264-354). The reference's build
@@ -7,21 +7,37 @@ of active feature indices (:390-426), deterministic reservoir-sampling cap
 (groupDataByKeyAndSample :468-527 with byteswap64 hash keys :510), feature
 projection to the subspace (:538-550), optional Pearson-correlation feature
 selection (:562-576), active-data lower-bound filter (:586-606) and passive
-data as the leftovers (:631-640) — happens ONCE, host-side at ingest, and
-produces static device arrays:
+data as the leftovers (:631-640) — happens ONCE at ingest, in two stages:
 
-- **EntityBlocks** (training): entities grouped into size buckets; each bucket
-  is a ``[B, R, k]`` ELL slab plus per-entity projector index arrays, so one
-  vmapped solver call fits all B entities simultaneously. This replaces the
-  reference's per-partition ``mapValues`` local solves
-  (RandomEffectCoordinate.scala:243-292) and its partitioner bin-packing
-  (RandomEffectDatasetPartitioner.scala:44): padding buckets instead of
-  packing bins.
-- **Scoring table** (active + passive rows): the full canonical table with
-  feature indices remapped into each row's owning entity's subspace, so
-  coordinate scoring is one gather-multiply-reduce against the
-  ``[num_entities, max_sub_dim]`` coefficient matrix — no join by REId.
-  Features outside an entity's subspace have their values zeroed (the
+1. **Plan (host)**: a fully vectorized numpy pass over the id codes — one
+   ``(entity, hash)`` lexsort gives the deterministic reservoir order, one
+   global ``unique`` over (entity, feature) pairs gives every subspace
+   projector, and one global ``searchsorted`` against the concatenated
+   projector key table remaps any (entity, feature) pair to its subspace
+   slot. There are NO per-entity Python loops; the reference's shuffles
+   (RandomEffectDataset.scala:264-354) become O(n log n) host sorts.
+2. **Device placement**: by default the plan is *lazy* — only the small
+   index arrays (bucket membership ``row_ids``, projector tables) are
+   pushed; the big per-bucket feature slabs and the scoring table are
+   **gathered on device, inside the already-jitted solver/scorer, from the
+   raw feature arrays resident in HBM**. The raw data crosses the
+   host->device link exactly once (at ``make_game_dataset``), and HBM
+   bandwidth — not the host link — feeds the MXU. ``lazy=False`` keeps the
+   fully materialized layout (used for ``DualEllFeatures`` shards and by
+   layout-introspection tests).
+
+- **EntityBlocks / BlockPlan** (training): entities grouped into size
+  buckets; each bucket materializes to a ``[B, R, k]`` ELL slab plus
+  per-entity projector index arrays, so one vmapped solver call fits all B
+  entities simultaneously. This replaces the reference's per-partition
+  ``mapValues`` local solves (RandomEffectCoordinate.scala:243-292) and its
+  partitioner bin-packing (RandomEffectDatasetPartitioner.scala:44): padding
+  buckets instead of packing bins.
+- **Scoring** (active + passive rows): every canonical row scores against
+  the ``[num_entities, max_sub_dim]`` coefficient matrix — lazily as a fused
+  remap-gather-reduce over the raw features (models/game.py
+  score_raw_features), or through the materialized width-capped table with
+  COO tail. Features outside an entity's subspace contribute nothing (the
   projector drop semantics of LinearSubspaceProjector.projectForward).
 
 Residual routing (addScoresToOffsets :83-110) reduces to gathering the
@@ -36,14 +52,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_tpu.data.dataset import DenseFeatures, Features, SparseFeatures
+from photon_tpu.data.dataset import (
+    DenseFeatures,
+    Features,
+    SparseFeatures,
+)
 from photon_tpu.data.game_data import GameDataset
 
 Array = jax.Array
 
 # Row-count caps for entity size buckets: entities are padded up to the next
-# cap, so worst-case padding waste is 2x within a bucket (SURVEY §7.3).
-DEFAULT_BUCKET_CAPS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+# cap, so worst-case padding waste is bounded within a bucket (SURVEY §7.3).
+# The ratio-4 ladder keeps the number of distinct solver shapes (one jit
+# compile each) small; padding rows carry weight 0 and cost only flops.
+DEFAULT_BUCKET_CAPS = (16, 64, 256, 1024, 4096)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,15 +83,17 @@ class RandomEffectDataConfiguration:
     active_data_lower_bound: int | None = None
     features_to_samples_ratio: float | None = None
     bucket_caps: tuple[int, ...] = DEFAULT_BUCKET_CAPS
-    # Scoring-table ELL width bound (SURVEY §7.3 width hazard): rows with
-    # more nnz spill into a COO tail instead of inflating every row's slab.
+    # Scoring-table ELL width bound (SURVEY §7.3 width hazard) for the
+    # MATERIALIZED layout: rows with more nnz spill into a COO tail instead
+    # of inflating every row's slab. The lazy layout reads the raw feature
+    # arrays directly and never builds a table, so the cap is moot there.
     score_table_width_cap: int | None = None
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class EntityBlocks:
-    """One size bucket of entities, padded to common shapes.
+    """One size bucket of entities, padded to common shapes (materialized).
 
     Training slab for a vmapped per-entity solver: leading axis B is the
     entity axis. Padding rows carry weight 0; padded subspace slots have
@@ -97,6 +121,144 @@ class EntityBlocks:
         return self.proj.shape[-1]
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BlockPlan:
+    """One size bucket in lazy form: plan indices + raw-data references.
+
+    ``materialize`` runs INSIDE the jitted solver, so the [B, R, k] slabs are
+    gathered from HBM-resident raw arrays by the compiled program — they
+    never exist on the host and never cross the host<->device link. The raw
+    leaves (``raw``/``labels``/``offsets``/``weights``) are shared references
+    to the GameDataset's arrays: every bucket's jit call sees the same
+    buffers.
+    """
+
+    entity_codes: Array  # [B] int32
+    row_ids: Array  # [B, R] int32 canonical rows; 0 for padding slots
+    row_counts: Array  # [B] int32 valid rows per entity
+    proj: Array  # [B, S] int32 sorted feature ids; -1 pads (trailing)
+    intercept_slots: Array  # [B] int32; -1 if none
+    raw: Features  # device-resident feature shard (Dense or Sparse ELL)
+    raw_labels: Array  # [n] shared
+    raw_offsets: Array  # [n] shared (base offsets)
+    raw_weights: Array  # [n] shared
+
+    @property
+    def num_entities(self) -> int:
+        return self.entity_codes.shape[0]
+
+    @property
+    def sub_dim(self) -> int:
+        return self.proj.shape[-1]
+
+    def materialize(self, residuals: Array | None = None) -> EntityBlocks:
+        """Gather the bucket's dense training slabs (traceable; runs in jit).
+
+        Returns an ``EntityBlocks`` whose ``offsets`` already include the
+        coordinate-descent residuals.
+        """
+        b, r = self.row_ids.shape
+        s = self.proj.shape[-1]
+        rows = self.row_ids
+        dtype = self.raw_weights.dtype
+        row_mask = jnp.arange(r, dtype=jnp.int32)[None, :] < (
+            self.row_counts[:, None]
+        )
+        labels = jnp.take(self.raw_labels, rows)
+        weights = jnp.where(
+            row_mask, jnp.take(self.raw_weights, rows), 0
+        )
+        offs = jnp.take(self.raw_offsets, rows)
+        if residuals is not None:
+            offs = offs + jnp.take(residuals, rows)
+        offs = jnp.where(row_mask, offs, 0)
+
+        proj = self.proj
+        valid = (proj >= 0).astype(dtype)
+        iota_s = jnp.arange(s, dtype=jnp.int32)[None, :]
+        penalty = jnp.where(
+            iota_s == self.intercept_slots[:, None], 0.0, valid
+        ).astype(dtype)
+
+        if isinstance(self.raw, DenseFeatures):
+            d = self.raw.x.shape[1]
+            # Per-entity feature -> slot LUT on a d+1 scratch column so -1
+            # projector pads scatter harmlessly into the spill slot.
+            pr = jnp.where(proj >= 0, proj, d)
+            lut = jnp.full((b, d + 1), -1, jnp.int32)
+            lut = lut.at[
+                jnp.arange(b, dtype=jnp.int32)[:, None], pr
+            ].set(jnp.broadcast_to(iota_s, (b, s)))
+            lut = lut[:, :d]  # [B, d]
+            xr = jnp.take(self.raw.x, rows, axis=0)  # [B, R, d]
+            x_indices = jnp.broadcast_to(
+                jnp.maximum(lut, 0)[:, None, :], (b, r, d)
+            )
+            x_values = jnp.where(
+                (lut >= 0)[:, None, :] & row_mask[:, :, None], xr, 0
+            )
+        else:
+            idx = jnp.take(self.raw.indices, rows, axis=0)  # [B, R, k]
+            val = jnp.take(self.raw.values, rows, axis=0)
+            k = idx.shape[-1]
+            sentinel = jnp.iinfo(jnp.int32).max
+            psort = jnp.where(proj >= 0, proj, sentinel)  # stays ascending
+            flat = idx.reshape(b, r * k)
+            slot = jax.vmap(jnp.searchsorted)(psort, flat)
+            slot = jnp.minimum(slot, s - 1)
+            hit = jnp.take_along_axis(psort, slot, axis=1) == flat
+            slot = slot.reshape(b, r, k).astype(jnp.int32)
+            hit = hit.reshape(b, r, k)
+            ok = hit & (val != 0) & row_mask[:, :, None]
+            x_indices = jnp.where(ok, slot, 0)
+            x_values = jnp.where(ok, val, 0)
+
+        return EntityBlocks(
+            entity_codes=self.entity_codes,
+            x_indices=x_indices,
+            x_values=x_values,
+            labels=labels,
+            offsets=offs,
+            weights=weights,
+            row_ids=jnp.where(row_mask, rows, 0),
+            proj=proj,
+            penalty_mask=penalty,
+            valid_mask=valid,
+            intercept_slots=self.intercept_slots,
+        )
+
+    # Eager conveniences so layout introspection (tests, debugging) works on
+    # either block form. Each access re-gathers; not for hot paths.
+    @property
+    def weights(self) -> Array:
+        return self.materialize().weights
+
+    @property
+    def labels(self) -> Array:
+        return self.materialize().labels
+
+    @property
+    def offsets(self) -> Array:
+        return self.materialize().offsets
+
+    @property
+    def x_values(self) -> Array:
+        return self.materialize().x_values
+
+    @property
+    def x_indices(self) -> Array:
+        return self.materialize().x_indices
+
+    @property
+    def valid_mask(self) -> Array:
+        return self.materialize().valid_mask
+
+    @property
+    def penalty_mask(self) -> Array:
+        return self.materialize().penalty_mask
+
+
 @dataclasses.dataclass(frozen=True)
 class RandomEffectDataset:
     """All device-resident state for one random-effect coordinate."""
@@ -104,32 +266,46 @@ class RandomEffectDataset:
     config: RandomEffectDataConfiguration
     num_entities: int
     entity_keys: tuple  # code -> raw entity key
-    blocks: tuple[EntityBlocks, ...]  # active data, size-bucketed
-    # Full-table scoring arrays (every canonical row, active AND passive):
-    score_codes: Array  # [n] int32 owning-entity code per row
-    score_indices: Array  # [n, k] int32 subspace-remapped; 0 where dropped
-    score_values: Array  # [n, k]; 0 where the feature is outside the subspace
+    blocks: tuple  # active data, size-bucketed: EntityBlocks | BlockPlan
     max_sub_dim: int
     sub_dims: np.ndarray  # [E] host-side subspace dims
     proj_all: np.ndarray  # [E, max_sub_dim] original feature ids; -1 pad
     num_features: int  # original feature-space dim of the shard
+    dtype: object = np.float32
+    # Scoring state, lazy form: owning-entity code per canonical row plus
+    # the device projector table; scores fuse against ``raw`` in HBM.
+    score_codes: Array | None = None  # [n] int32
+    raw: Features | None = None  # device raw shard (lazy mode)
+    proj_dev: Array | None = None  # [E, max_sub_dim] device; -1 pad
+    # Scoring state, materialized form (score_indices is None in lazy mode):
+    score_indices: Array | None = None  # [n, k] int32 subspace-remapped
+    score_values: Array | None = None  # [n, k]; 0 where outside the subspace
     # COO overflow tail for rows wider than the configured score-table cap
     # (empty arrays when uncapped); tail rows are sorted ascending.
     score_tail_rows: Array | None = None  # [t] int32
     score_tail_indices: Array | None = None  # [t] int32 subspace slots
     score_tail_values: Array | None = None  # [t]
+    # Host mirrors of small per-block plan arrays (one per ``blocks`` entry)
+    # so per-fit bookkeeping never pulls from the device.
+    block_codes_np: tuple = ()
+    block_intercepts_np: tuple = ()
 
-    def real_entity_mask(self, block: EntityBlocks) -> np.ndarray:
-        """[B] bool — True for real entities. Mesh-sharded blocks pad the
-        entity axis with inert entities whose code is ``num_entities``
-        (parallel/mesh.py shard_random_effect_dataset); this helper owns
-        that sentinel convention."""
-        return np.asarray(block.entity_codes) < self.num_entities
+    @property
+    def is_lazy(self) -> bool:
+        return self.score_indices is None
+
+    def real_entity_mask(self, block_index: int) -> np.ndarray:
+        """[B] bool — True for real entities of block ``block_index``.
+        Mesh-sharded blocks pad the entity axis with inert entities whose
+        code is ``num_entities`` (parallel/mesh.py
+        shard_random_effect_dataset); this helper owns that convention."""
+        return self.block_codes_np[block_index] < self.num_entities
 
     @property
     def num_active_entities(self) -> int:
         return sum(
-            int(self.real_entity_mask(b).sum()) for b in self.blocks
+            int(self.real_entity_mask(i).sum())
+            for i in range(len(self.blocks))
         )
 
 
@@ -150,36 +326,6 @@ def _byteswap64_mix(uids: np.ndarray, seed: np.uint64) -> np.ndarray:
     z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
     z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
     return z ^ (z >> np.uint64(31))
-
-
-def _rows_to_coo(features: Features) -> tuple[np.ndarray, np.ndarray, int]:
-    """Host-side (indices[n, k], values[n, k]) view of a feature shard."""
-    if isinstance(features, SparseFeatures):
-        return (
-            np.asarray(features.indices),
-            np.asarray(features.values),
-            features.d,
-        )
-    assert isinstance(features, DenseFeatures)
-    x = np.asarray(features.x)
-    n, d = x.shape
-    idx = np.broadcast_to(np.arange(d, dtype=np.int32), (n, d))
-    return idx.copy(), x.copy(), d
-
-
-def _remap_ell_rows(
-    idx_rows: np.ndarray,  # [r, k_in] original feature ids
-    val_rows: np.ndarray,  # [r, k_in]
-    lut: np.ndarray,  # [num_features] original -> sub slot, -1 dropped
-    k_out: int,
-) -> tuple[np.ndarray, np.ndarray]:
-    """Vectorized subspace remap: gather slots, compact valid entries left."""
-    sub = lut[idx_rows]  # [r, k_in]
-    valid = (val_rows != 0.0) & (sub >= 0)
-    order = np.argsort(~valid, axis=1, kind="stable")  # valid entries first
-    sub_c = np.take_along_axis(np.where(valid, sub, 0), order, axis=1)
-    val_c = np.take_along_axis(np.where(valid, val_rows, 0.0), order, axis=1)
-    return sub_c[:, :k_out].astype(np.int32), val_c[:, :k_out]
 
 
 def _pearson_select(
@@ -221,84 +367,401 @@ def _pearson_select(
     return np.sort(active_features[order])
 
 
-def _build_score_table(
-    codes: np.ndarray,  # [n] entity codes into projs; -1 = no entity
-    ell_idx: np.ndarray,  # [n, k_in]
-    ell_val: np.ndarray,  # [n, k_in]
-    projs_of,  # callable e -> [s_e] sorted original feature ids
-    num_entities: int,
-    num_features: int,
-    sort: tuple[np.ndarray, np.ndarray, np.ndarray] | None = None,
-    width_cap: int | None = None,
-):
-    """Shared scoring-table remap: every row's ELL entries mapped into its
-    owning entity's subspace (dropped features zeroed). Used by the dataset
-    build (active+passive rows) and by ``remap_for_scoring`` (new data).
-    ``sort`` optionally supplies a precomputed (order, starts, ends)
-    entity grouping to skip the argsort.
+@dataclasses.dataclass(frozen=True)
+class _ProjectorTable:
+    """Flat per-entity subspace projectors (all host numpy).
 
-    ``width_cap`` bounds the slab width (SURVEY §7.3 width hazard): the
-    [n, cap] slab is the ONLY O(n)-wide allocation — entries beyond the cap
-    stream into a COO tail per entity, so one dense row never inflates host
-    (or device) memory for every row. Returns (si, sv, tail) where tail is
-    None when uncapped, else (rows, indices, values) sorted by row."""
-    n = codes.shape[0]
-    k_all = max(int((ell_val != 0.0).sum(axis=1).max(initial=0)), 1)
-    k_slab = k_all if width_cap is None else max(min(width_cap, k_all), 1)
-    si = np.zeros((n, k_slab), dtype=np.int32)
-    sv = np.zeros((n, k_slab), dtype=ell_val.dtype)
-    tail_rows: list[np.ndarray] = []
-    tail_idx: list[np.ndarray] = []
-    tail_val: list[np.ndarray] = []
-    if sort is not None:
-        order, starts, ends = sort
-    else:
-        order = np.argsort(codes, kind="stable")
-        sorted_codes = codes[order]
-        starts = np.searchsorted(sorted_codes, np.arange(num_entities))
-        ends = np.searchsorted(
-            sorted_codes, np.arange(num_entities), side="right"
+    ``keys`` is ``entity * stride + feature`` for every (entity, feature)
+    pair in any subspace, globally sorted — so ONE ``np.searchsorted``
+    resolves any batch of pairs to subspace slots (``slot = pos -
+    offsets[entity]``). This replaces the reference's per-entity
+    LinearSubspaceProjector maps (projector/LinearSubspaceProjector.scala:36)
+    with index arithmetic.
+    """
+
+    keys: np.ndarray  # [total] int64, sorted
+    offsets: np.ndarray  # [E + 1] int64
+    stride: int
+    num_entities: int
+
+    @property
+    def sub_dims(self) -> np.ndarray:
+        return np.diff(self.offsets)
+
+    def features_of(self, e: int) -> np.ndarray:
+        return self.keys[self.offsets[e]:self.offsets[e + 1]] % self.stride
+
+    def lookup(
+        self, codes: np.ndarray, feats: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized (entity, feature) -> (slot, found). Any shape; codes
+        broadcastable to feats. Negative codes never match."""
+        codes = np.broadcast_to(codes, feats.shape)
+        keys = (
+            np.maximum(codes, 0).astype(np.int64) * self.stride
+            + feats.astype(np.int64)
         )
-    # A trained model's projectors may reference feature ids beyond this
-    # dataset's shard dimension; size the LUT to cover both so unknown
-    # features are dropped, not crashed on.
-    lut_size = num_features
-    for e in range(num_entities):
-        p = projs_of(e)
-        if p.size:
-            lut_size = max(lut_size, int(p.max()) + 1)
-    lut = np.full(lut_size, -1, dtype=np.int64)
-    for e in range(num_entities):
-        rows = order[starts[e] : ends[e]]
-        if rows.size == 0:
-            continue
-        p = projs_of(e)
-        lut[p] = np.arange(p.size)
-        # Remap at this entity's own width; only the transient per-entity
-        # buffer sees the full width.
-        k_e = max(int((ell_val[rows] != 0.0).sum(axis=1).max(initial=0)), 1)
-        ri, rv = _remap_ell_rows(ell_idx[rows], ell_val[rows], lut, k_e)
-        if k_e <= k_slab:
-            si[rows, :k_e] = ri
-            sv[rows, :k_e] = rv
+        if self.keys.size == 0:
+            z = np.zeros(feats.shape, dtype=np.int64)
+            return z, np.zeros(feats.shape, dtype=bool)
+        pos = np.searchsorted(self.keys, keys)
+        pos_c = np.minimum(pos, self.keys.size - 1)
+        found = (self.keys[pos_c] == keys) & (codes >= 0)
+        slot = pos_c - self.offsets[np.maximum(codes, 0)]
+        return np.where(found, slot, 0), found
+
+    @staticmethod
+    def from_lists(
+        projs: list[np.ndarray], stride: int
+    ) -> "_ProjectorTable":
+        e = len(projs)
+        sizes = np.array([p.size for p in projs], dtype=np.int64)
+        offsets = np.zeros(e + 1, dtype=np.int64)
+        np.cumsum(sizes, out=offsets[1:])
+        if e and offsets[-1]:
+            ids = np.repeat(np.arange(e, dtype=np.int64), sizes)
+            keys = ids * stride + np.concatenate(
+                [p.astype(np.int64) for p in projs if p.size]
+            )
         else:
-            si[rows] = ri[:, :k_slab]
-            sv[rows] = rv[:, :k_slab]
-            over_i, over_v = ri[:, k_slab:], rv[:, k_slab:]
-            mask = over_v != 0.0
-            if mask.any():
-                row_of = np.broadcast_to(
-                    rows[:, None].astype(np.int64), mask.shape)
-                tail_rows.append(row_of[mask])
-                tail_idx.append(over_i[mask].astype(np.int64))
-                tail_val.append(over_v[mask])
-        lut[p] = -1
+            keys = np.empty(0, dtype=np.int64)
+        return _ProjectorTable(keys, offsets, stride, e)
+
+
+def _subset_rows_widened(
+    ell_idx: np.ndarray,
+    ell_val: np.ndarray,
+    tail,  # (rows, indices, values) sorted by row, or None
+    rows: np.ndarray,  # unique row ids to take
+) -> tuple[np.ndarray, np.ndarray]:
+    """ELL view of a row subset with the rows' COO tail entries appended as
+    extra columns. Width grows only to the widest row IN THE SUBSET, so
+    per-bucket / per-entity widening stays bounded by that group's own
+    content — never by the single widest row of the whole table."""
+    si = ell_idx[rows]
+    sv = ell_val[rows]
+    if tail is None:
+        return si, sv
+    tr, ti, tv = tail
+    n = ell_idx.shape[0]
+    m = rows.shape[0]
+    inv = np.full(n, -1, dtype=np.int64)
+    inv[rows] = np.arange(m)
+    sel = inv[tr] >= 0
+    if not sel.any():
+        return si, sv
+    # Global within-row rank of tail entries (tail rows sorted ascending).
+    g_starts = np.searchsorted(tr, np.arange(n))
+    g_rank = np.arange(tr.size) - g_starts[tr]
+    r_of = inv[tr[sel]]
+    kx = int(g_rank[sel].max()) + 1
+    k0 = si.shape[1]
+    out_i = np.zeros((m, k0 + kx), dtype=si.dtype)
+    out_v = np.zeros((m, k0 + kx), dtype=sv.dtype)
+    out_i[:, :k0] = si
+    out_v[:, :k0] = sv
+    out_i[r_of, k0 + g_rank[sel]] = ti[sel]
+    out_v[r_of, k0 + g_rank[sel]] = tv[sel]
+    return out_i, out_v
+
+
+def _compact_left(
+    slot: np.ndarray, val: np.ndarray, found: np.ndarray, k_out: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Left-compact valid ELL entries per row; truncate/pad to ``k_out``."""
+    order = np.argsort(~found, axis=1, kind="stable")
+    slot_c = np.take_along_axis(np.where(found, slot, 0), order, axis=1)
+    val_c = np.take_along_axis(np.where(found, val, 0.0), order, axis=1)
+    n, k = slot_c.shape
+    if k_out > k:
+        slot_c = np.pad(slot_c, ((0, 0), (0, k_out - k)))
+        val_c = np.pad(val_c, ((0, 0), (0, k_out - k)))
+    return slot_c[:, :k_out].astype(np.int32), val_c[:, :k_out]
+
+
+@dataclasses.dataclass
+class _Plan:
+    """Host-side build plan: everything downstream layout needs, no loops."""
+
+    codes: np.ndarray  # [n] int64 owning-entity code per row
+    perm: np.ndarray  # [n] rows sorted by (entity, reservoir hash)
+    starts: np.ndarray  # [E]
+    counts_full: np.ndarray  # [E] rows per entity
+    counts: np.ndarray  # [E] kept (reservoir-capped) rows per entity
+    keep_sorted: np.ndarray  # [n] bool mask in sorted order
+    rank_sorted: np.ndarray  # [n] within-entity rank in sorted order
+    active: np.ndarray  # [E] bool — trains a model
+    table: _ProjectorTable
+    proj_all: np.ndarray  # [E, S] feature ids, -1 pad
+    sub_dims: np.ndarray  # [E]
+    max_sub_dim: int
+    intercept_slots_all: np.ndarray  # [E] int32; -1 none
+    bucket_members: dict  # cap -> np.ndarray of entity codes
+    num_features: int
+
+
+def _plan_random_effect(
+    game_data: GameDataset,
+    config: RandomEffectDataConfiguration,
+    *,
+    intercept_index: int | None,
+    extra_features: dict[int, np.ndarray] | None,
+) -> _Plan:
+    """Vectorized host planning pass (see module docstring, stage 1)."""
+    tag = game_data.id_tags[config.random_effect_type]
+    codes = tag.host_codes().astype(np.int64, copy=False)
+    num_entities = tag.num_groups
+    n = codes.shape[0]
+    ell_idx, ell_val, num_features = game_data.host_shard_coo(
+        config.feature_shard_id
+    )
+    labels_np = game_data.host_column("labels")
+    uids = (
+        game_data.uids.astype(np.int64)
+        if game_data.uids is not None
+        else np.arange(n, dtype=np.int64)
+    )
+
+    # --- 1. deterministic reservoir cap: per entity keep the
+    # active_data_upper_bound rows with smallest hash keys -----------------
+    seed = _stable_type_seed(config.random_effect_type)
+    order_keys = _byteswap64_mix(uids, seed)
+    perm = np.lexsort((order_keys, codes))
+    sorted_codes = codes[perm]
+    starts = np.searchsorted(sorted_codes, np.arange(num_entities))
+    counts_full = np.bincount(codes, minlength=num_entities).astype(np.int64)
+
+    upper = config.active_data_upper_bound
+    lower = config.active_data_lower_bound
+    counts = (
+        counts_full if upper is None else np.minimum(counts_full, upper)
+    )
+    # Within-entity rank of each sorted position (0 = smallest hash key).
+    rank_sorted = np.arange(n, dtype=np.int64) - np.repeat(
+        starts, counts_full
+    ) if n else np.empty(0, dtype=np.int64)
+    keep_sorted = (
+        np.ones(n, dtype=bool) if upper is None else rank_sorted < upper
+    )
+    # Lower-bound filter: too-small entities train no model (their rows
+    # still score via the zero row of the coefficient matrix).
+    active = counts >= (lower or 1)
+
+    # --- 2. per-entity subspace projectors (one global unique) ------------
+    stride = num_features
+    if extra_features:
+        for arr in extra_features.values():
+            a = np.asarray(arr)
+            if a.size:
+                stride = max(stride, int(a.max()) + 1)
+    tail = game_data.host_shard_tail(config.feature_shard_id)
+    proj_mask = keep_sorted & active[sorted_codes]
+    rows_p = perm[proj_mask]
+    pair_codes = sorted_codes[proj_mask]
+    if rows_p.size:
+        iv = ell_idx[rows_p]
+        present = ell_val[rows_p] != 0.0
+        pair_keys = (
+            np.broadcast_to(pair_codes[:, None], iv.shape)[present]
+            * np.int64(stride)
+            + iv[present].astype(np.int64)
+        )
+        if tail is not None:
+            # Dual-ELL overflow entries contribute subspace features too.
+            mask_rows = np.zeros(n, dtype=bool)
+            mask_rows[rows_p] = True
+            tr, ti, tv = tail
+            sel = mask_rows[tr] & (tv != 0.0)
+            if sel.any():
+                tail_keys = (
+                    codes[tr[sel]] * np.int64(stride)
+                    + ti[sel].astype(np.int64)
+                )
+                pair_keys = np.concatenate([pair_keys, tail_keys])
+        uniq = np.unique(pair_keys)
+    else:
+        uniq = np.empty(0, dtype=np.int64)
+
+    needs_rework = bool(extra_features) or (
+        config.features_to_samples_ratio is not None
+    )
+    if needs_rework:
+        e_of = uniq // stride
+        f_of = uniq % stride
+        e_starts = np.searchsorted(e_of, np.arange(num_entities))
+        e_ends = np.searchsorted(
+            e_of, np.arange(num_entities), side="right"
+        )
+        projs = [f_of[e_starts[e]:e_ends[e]] for e in range(num_entities)]
+        ratio = config.features_to_samples_ratio
+        active_ids = np.nonzero(active)[0]
+        for e in active_ids:
+            act = projs[e]
+            if ratio is not None:
+                # Kept rows are the first counts[e] of the entity's sorted
+                # span (rank < upper by construction) — O(rows_e), not a
+                # full-array scan.
+                rows_e = perm[starts[e]:starts[e] + counts[e]]
+                keep = max(int(ratio * rows_e.size), 1)
+                pe_i, pe_v = _subset_rows_widened(
+                    ell_idx, ell_val, tail, rows_e
+                )
+                act = _pearson_select(
+                    pe_v, pe_i, labels_np[rows_e],
+                    act, keep, intercept_index, num_features,
+                )
+            # Prior-model support is unioned AFTER the Pearson filter:
+            # features a warm-start model depends on must stay in the
+            # subspace even when inactive/filtered in the current data
+            # (RandomEffectDataset.scala:390-426 unions unconditionally).
+            if extra_features and e in extra_features:
+                act = np.union1d(
+                    act, np.asarray(extra_features[e], dtype=act.dtype)
+                )
+            projs[e] = act
+        table = _ProjectorTable.from_lists(projs, stride)
+    else:
+        offsets = np.zeros(num_entities + 1, dtype=np.int64)
+        e_of = uniq // stride
+        offsets[1:] = np.searchsorted(
+            e_of, np.arange(num_entities), side="right"
+        )
+        table = _ProjectorTable(uniq, offsets, stride, num_entities)
+
+    sub_dims = table.sub_dims
+    max_sub_dim = max(int(sub_dims.max()) if num_entities else 1, 1)
+    # proj_all scatter-fill: one flat write.
+    proj_all = np.full((num_entities, max_sub_dim), -1, dtype=np.int64)
+    if table.keys.size:
+        row_of = np.repeat(np.arange(num_entities), sub_dims)
+        col_of = np.arange(table.keys.size) - np.repeat(
+            table.offsets[:-1], sub_dims
+        )
+        proj_all[row_of, col_of] = table.keys % stride
+
+    # Intercept slot per entity (vectorized projector lookup).
+    if intercept_index is not None and num_entities:
+        slots, found = table.lookup(
+            np.arange(num_entities),
+            np.full(num_entities, intercept_index, dtype=np.int64),
+        )
+        intercept_slots_all = np.where(found, slots, -1).astype(np.int32)
+    else:
+        intercept_slots_all = np.full(num_entities, -1, dtype=np.int32)
+
+    # --- 3. size-bucket membership ----------------------------------------
+    caps = np.asarray(sorted(config.bucket_caps), dtype=np.int64)
+    active_ids = np.nonzero(active)[0]
+    r = counts[active_ids]
+    pos = np.searchsorted(caps, r)
+    # Entities above the largest cap round up to the next power of two so
+    # heavy-tailed size distributions share padded shapes (and jit compiles
+    # of the solver) instead of one shape per distinct size.
+    pow2 = np.left_shift(
+        np.int64(1),
+        np.ceil(np.log2(np.maximum(r, 1).astype(np.float64))).astype(
+            np.int64
+        ),
+    )
+    cap_of = np.where(pos < caps.size, caps[np.minimum(pos, caps.size - 1)],
+                      pow2)
+    bucket_members = {
+        int(c): active_ids[cap_of == c] for c in np.unique(cap_of)
+    }
+    return _Plan(
+        codes=codes,
+        perm=perm,
+        starts=starts,
+        counts_full=counts_full,
+        counts=counts,
+        keep_sorted=keep_sorted,
+        rank_sorted=rank_sorted,
+        active=active,
+        table=table,
+        proj_all=proj_all,
+        sub_dims=sub_dims,
+        max_sub_dim=max_sub_dim,
+        intercept_slots_all=intercept_slots_all,
+        bucket_members=bucket_members,
+        num_features=num_features,
+    )
+
+
+def _bucket_rows(plan: _Plan, members: np.ndarray, cap: int):
+    """Vectorized bucket row layout: (rows_flat, t_of, r_of, counts_b).
+
+    ``rows_flat`` are the kept canonical rows of all member entities,
+    grouped by entity (reservoir hash order within); ``t_of``/``r_of`` are
+    their (bucket slot, within-entity rank) coordinates.
+    """
+    is_member = np.zeros(plan.active.shape[0] + 1, dtype=bool)
+    is_member[members] = True
+    sorted_codes = plan.codes[plan.perm]
+    sel = plan.keep_sorted & is_member[sorted_codes]
+    rows_flat = plan.perm[sel]
+    owner = sorted_codes[sel]
+    member_rank = np.zeros(plan.active.shape[0], dtype=np.int64)
+    member_rank[members] = np.arange(members.size)
+    t_of = member_rank[owner]
+    r_of = plan.rank_sorted[sel]
+    return rows_flat, t_of, r_of, plan.counts[members]
+
+
+def _score_table_arrays(
+    codes: np.ndarray,
+    ell_idx: np.ndarray,
+    ell_val: np.ndarray,
+    table: _ProjectorTable,
+    width_cap: int | None,
+    tail_in=None,  # input COO overflow of a DualEll shard, or None
+):
+    """Materialized scoring-table remap for ALL rows (vectorized).
+
+    Returns (si, sv, tail) where tail is None when uncapped, else
+    (rows, indices, values) sorted by row — entries beyond the slab cap
+    stream into a COO tail so one dense row never inflates every row's slab
+    (SURVEY §7.3 width hazard). ``tail_in`` overflow entries of a dual-ELL
+    input stay in COO form end to end when a cap is set; only an uncapped
+    build widens them into the rectangular output.
+    """
+    if tail_in is not None and width_cap is None:
+        # Rectangular output was explicitly requested without a bound:
+        # widen (old behavior). Width-hazard data should set the cap.
+        ell_idx, ell_val = _subset_rows_widened(
+            ell_idx, ell_val, tail_in, np.arange(codes.shape[0])
+        )
+        tail_in = None
+    slot, found = table.lookup(codes[:, None], ell_idx)
+    found = found & (ell_val != 0.0)
+    k_comp = max(int(found.sum(axis=1).max(initial=0)), 1)
     if width_cap is None:
+        si, sv = _compact_left(slot, ell_val, found, k_comp)
         return si, sv, None
-    if tail_rows:
-        tr = np.concatenate(tail_rows)
-        ti = np.concatenate(tail_idx)
-        tv = np.concatenate(tail_val)
+    k_slab = max(min(width_cap, k_comp), 1)
+    si_f, sv_f = _compact_left(slot, ell_val, found, k_comp)
+    si, sv = si_f[:, :k_slab], sv_f[:, :k_slab]
+    over_i, over_v = si_f[:, k_slab:], sv_f[:, k_slab:]
+    mask = over_v != 0.0
+    parts_r, parts_i, parts_v = [], [], []
+    if mask.any():
+        row_of = np.broadcast_to(
+            np.arange(codes.shape[0], dtype=np.int64)[:, None], mask.shape
+        )
+        parts_r.append(row_of[mask])
+        parts_i.append(over_i[mask].astype(np.int64))
+        parts_v.append(over_v[mask])
+    if tail_in is not None:
+        tr_in, ti_in, tv_in = tail_in
+        slot_t, found_t = table.lookup(codes[tr_in], ti_in)
+        ok = found_t & (tv_in != 0.0)
+        if ok.any():
+            parts_r.append(tr_in[ok].astype(np.int64))
+            parts_i.append(slot_t[ok].astype(np.int64))
+            parts_v.append(tv_in[ok])
+    if parts_r:
+        tr = np.concatenate(parts_r)
+        ti = np.concatenate(parts_i)
+        tv = np.concatenate(parts_v)
         o = np.argsort(tr, kind="stable")  # segment_sum wants sorted rows
         tail = (tr[o], ti[o], tv[o])
     else:
@@ -322,44 +785,23 @@ def remap_for_scoring(
     """Remap an arbitrary GameDataset's rows into trained entity subspaces.
 
     Returns (codes, indices, values, tail) consumable by
-    ``score_entity_table_with_tail`` — the scoring path for validation /
-    test data (RandomEffectModel.score :70 joins new data by REId; entities
-    unseen at training time contribute score 0, matching the reference's
-    left-join semantics where rows without a model get no score). ``tail``
-    is None when ``width_cap`` is unset, else device (rows, indices, values)
-    arrays for the capped table's COO overflow (the SURVEY §7.3 width
-    bound, same convention as the training-side score table).
+    ``score_entity_table_with_tail`` — the materialized scoring path for
+    validation / test data (RandomEffectModel.score :70 joins new data by
+    REId; entities unseen at training time contribute score 0, matching the
+    reference's left-join semantics where rows without a model get no
+    score). ``tail`` is None when ``width_cap`` is unset, else device
+    (rows, indices, values) arrays for the capped table's COO overflow.
     """
     if dtype is None:
         dtype = game_data.labels.dtype
-    tag = game_data.id_tags[re_type]
-    vocab = {str(k): i for i, k in enumerate(entity_keys)}
-    # this-dataset code -> trained code (-1 unseen)
-    code_map = np.array(
-        [vocab.get(str(k), -1) for k in tag.inverse], dtype=np.int64
+    codes = scoring_codes(game_data, re_type, entity_keys)
+    ell_idx, ell_val, num_features = game_data.host_shard_coo(
+        feature_shard_id
     )
-    if len(tag.inverse) and len(entity_keys) and (code_map < 0).all():
-        import warnings
-
-        warnings.warn(
-            f"remap_for_scoring({re_type!r}): none of {len(tag.inverse)} "
-            f"dataset entities match the {len(entity_keys)} model entities "
-            "— every random-effect score will be 0",
-            stacklevel=2,
-        )
-    codes = code_map[np.asarray(tag.codes)]
-
-    ell_idx, ell_val, num_features = _rows_to_coo(
-        game_data.feature_shards[feature_shard_id]
-    )
-    si, sv, tail = _build_score_table(
-        codes,
-        ell_idx,
-        ell_val,
-        lambda e: proj_all[e][proj_all[e] >= 0],
-        len(entity_keys),
-        num_features,
-        width_cap=width_cap,
+    table = projector_table_from_proj_all(proj_all, num_features)
+    si, sv, tail = _score_table_arrays(
+        codes, ell_idx, ell_val, table, width_cap,
+        tail_in=game_data.host_shard_tail(feature_shard_id),
     )
     # Unseen entities: clamp the code and zero the values -> score 0.
     unseen = codes < 0
@@ -368,9 +810,8 @@ def remap_for_scoring(
     tail_out = None
     if tail is not None:
         tr, ti, tv = tail
-        # Invariant: the tail only holds rows of KNOWN entities — the
-        # build's searchsorted grouping spans codes 0..E-1, so code -1
-        # (unseen) rows never reach the per-entity remap loop.
+        # Invariant: negative-code rows never produce projector hits, so
+        # the tail only holds rows of KNOWN entities.
         assert not unseen[tr].any()
         tail_out = (
             jnp.asarray(tr.astype(np.int32)),
@@ -385,6 +826,51 @@ def remap_for_scoring(
     )
 
 
+def scoring_codes(
+    game_data: GameDataset, re_type: str, entity_keys: tuple
+) -> np.ndarray:
+    """[n] trained-entity code per row of ``game_data`` (-1 = unseen)."""
+    tag = game_data.id_tags[re_type]
+    vocab = {str(k): i for i, k in enumerate(entity_keys)}
+    code_map = np.array(
+        [vocab.get(str(k), -1) for k in tag.inverse], dtype=np.int64
+    )
+    if len(tag.inverse) and len(entity_keys) and (code_map < 0).all():
+        import warnings
+
+        warnings.warn(
+            f"scoring remap({re_type!r}): none of {len(tag.inverse)} "
+            f"dataset entities match the {len(entity_keys)} model entities "
+            "— every random-effect score will be 0",
+            stacklevel=2,
+        )
+    return code_map[tag.host_codes()]
+
+
+def projector_table_from_proj_all(
+    proj_all: np.ndarray, num_features: int
+) -> _ProjectorTable:
+    """Rebuild the flat projector table from a [E, S] proj matrix.
+
+    A trained model's projectors may reference feature ids beyond a new
+    dataset's shard dimension; the stride covers both so unknown features
+    are dropped, not crashed on."""
+    e, s = proj_all.shape if proj_all.ndim == 2 else (0, 0)
+    stride = num_features
+    if proj_all.size:
+        stride = max(stride, int(proj_all.max(initial=0)) + 1)
+    valid = proj_all >= 0
+    sizes = valid.sum(axis=1).astype(np.int64) if e else np.empty(0, np.int64)
+    offsets = np.zeros(e + 1, dtype=np.int64)
+    np.cumsum(sizes, out=offsets[1:])
+    if e and offsets[-1]:
+        row_of = np.repeat(np.arange(e, dtype=np.int64), sizes)
+        keys = row_of * stride + proj_all[valid].astype(np.int64)
+    else:
+        keys = np.empty(0, dtype=np.int64)
+    return _ProjectorTable(keys, offsets, stride, e)
+
+
 def build_random_effect_dataset(
     game_data: GameDataset,
     config: RandomEffectDataConfiguration,
@@ -392,6 +878,7 @@ def build_random_effect_dataset(
     intercept_index: int | None = None,
     extra_features: dict[int, np.ndarray] | None = None,
     dtype=None,
+    lazy: bool | None = None,
 ) -> RandomEffectDataset:
     """One-shot host-side ingest of a random-effect coordinate's data.
 
@@ -399,180 +886,176 @@ def build_random_effect_dataset(
     in the entity's subspace even if inactive in the data — the prior-model
     support used for warm-start/incremental training
     (RandomEffectDataset.scala:390-426 unions the existing model's features).
+
+    ``lazy`` (default: auto) selects the device layout: lazy BlockPlans that
+    materialize inside the jitted solver (Dense/Sparse shards), or fully
+    materialized EntityBlocks + scoring table (always used for
+    ``DualEllFeatures`` shards, whose COO tail is not row-gatherable).
     """
+    requested_dtype = dtype
     if dtype is None:
         dtype = game_data.labels.dtype
-    tag = game_data.id_tags[config.random_effect_type]
-    codes = np.asarray(tag.codes).astype(np.int64, copy=False)
-    num_entities = tag.num_groups
-    n = codes.shape[0]
-
     feats = game_data.feature_shards[config.feature_shard_id]
-    ell_idx, ell_val, num_features = _rows_to_coo(feats)
-    labels_np = np.asarray(game_data.labels)
-    offsets_np = np.asarray(game_data.offsets)
-    weights_np = np.asarray(game_data.weights)
-    uids = (
-        game_data.uids.astype(np.int64)
-        if game_data.uids is not None
-        else np.arange(n, dtype=np.int64)
+    lazy_capable = isinstance(feats, (DenseFeatures, SparseFeatures))
+    # The lazy layout trains straight off the raw device arrays, so it
+    # cannot honor a dtype different from the data's.
+    dtype_matches = (
+        requested_dtype is None
+        or jnp.dtype(requested_dtype) == jnp.dtype(game_data.labels.dtype)
     )
-
-    # --- 1. deterministic reservoir cap: per entity keep the
-    # active_data_upper_bound rows with smallest hash keys -----------------
-    seed = _stable_type_seed(config.random_effect_type)
-    order_keys = _byteswap64_mix(uids, seed)
-    # Sort rows by (entity, hash key): each entity's rows become contiguous in
-    # a deterministic pseudo-random order.
-    perm = np.lexsort((order_keys, codes))
-    sorted_codes = codes[perm]
-    starts = np.searchsorted(sorted_codes, np.arange(num_entities))
-    ends = np.searchsorted(sorted_codes, np.arange(num_entities), side="right")
-
-    upper = config.active_data_upper_bound
-    lower = config.active_data_lower_bound
-
-    entity_rows: list[np.ndarray] = []
-    active = np.zeros(num_entities, dtype=bool)
-    for e in range(num_entities):
-        rows = perm[starts[e] : ends[e]]
-        if upper is not None and rows.size > upper:
-            rows = rows[:upper]
-        entity_rows.append(rows)
-        # Lower-bound filter: too-small entities train no model (their rows
-        # still score via the zero row of the coefficient matrix).
-        active[e] = rows.size >= (lower or 1)
-
-    # --- 2. per-entity subspace projectors --------------------------------
-    # Vectorized: one global unique over (entity, feature) pairs replaces
-    # the per-entity np.unique loop (generateLinearSubspaceProjectors'
-    # foldByKey becomes a single sort).
-    projs: list[np.ndarray] = [np.empty(0, dtype=np.int64)] * num_entities
-    sub_dims = np.zeros(num_entities, dtype=np.int64)
-    active_ids = np.nonzero(active)[0]
-    if active_ids.size:
-        kept_rows = np.concatenate([entity_rows[e] for e in active_ids])
-        kept_codes = np.repeat(
-            active_ids, [entity_rows[e].size for e in active_ids]
+    if lazy is None:
+        # An explicit score-table width cap is a signal that max_sub_dim is
+        # dominated by heavy entities (SURVEY §7.3): the lazy scorer's
+        # [n, S] gather intermediates would recreate exactly the hazard the
+        # cap bounds, so honor it with the materialized dual-ELL table.
+        lazy = (
+            lazy_capable
+            and dtype_matches
+            and config.score_table_width_cap is None
         )
-        iv = ell_idx[kept_rows]
-        present = ell_val[kept_rows] != 0.0
-        pair_codes = np.broadcast_to(kept_codes[:, None], iv.shape)[present]
-        pair_keys = (
-            pair_codes.astype(np.int64) * num_features
-            + iv[present].astype(np.int64)
+    if lazy and not lazy_capable:
+        raise TypeError(
+            "lazy random-effect layout requires Dense or Sparse (ELL) "
+            f"features, got {type(feats).__name__}"
         )
-        uniq = np.unique(pair_keys)
-        e_of = uniq // num_features
-        f_of = uniq % num_features
-        e_starts = np.searchsorted(e_of, np.arange(num_entities))
-        e_ends = np.searchsorted(e_of, np.arange(num_entities), side="right")
-        for e in active_ids:
-            projs[e] = f_of[e_starts[e]:e_ends[e]]  # sorted by feature id
+    if lazy and not dtype_matches:
+        raise ValueError(
+            f"lazy random-effect layout cannot retype the raw data "
+            f"({game_data.labels.dtype} -> {requested_dtype}); pass "
+            "lazy=False or build the GameDataset in the target dtype"
+        )
+    plan = _plan_random_effect(
+        game_data, config,
+        intercept_index=intercept_index, extra_features=extra_features,
+    )
+    tag = game_data.id_tags[config.random_effect_type]
+    num_entities = tag.num_groups
 
-    ratio = config.features_to_samples_ratio
-    for e in active_ids:
-        act = projs[e]
-        if ratio is not None:
-            rows = entity_rows[e]
-            keep = max(int(ratio * rows.size), 1)
-            act = _pearson_select(
-                ell_val[rows], ell_idx[rows], labels_np[rows], act, keep,
-                intercept_index, num_features,
-            )
-        # Prior-model support is unioned AFTER the Pearson filter: features a
-        # warm-start model depends on must stay in the subspace even when
-        # inactive/filtered in the current data (RandomEffectDataset.scala:
-        # 390-426 unions the existing model's features unconditionally).
-        if extra_features and e in extra_features:
-            act = np.union1d(act, np.asarray(extra_features[e], dtype=act.dtype))
-        projs[e] = act
-        sub_dims[e] = act.size
+    # Per-bucket plan arrays (all vectorized scatters).
+    bucket_host = []
+    for cap in sorted(plan.bucket_members):
+        members = plan.bucket_members[cap]
+        rows_flat, t_of, r_of, counts_b = _bucket_rows(plan, members, cap)
+        b = members.size
+        brow = np.zeros((b, cap), dtype=np.int32)
+        brow[t_of, r_of] = rows_flat
+        sub = plan.sub_dims[members]
+        s = max(int(sub.max(initial=0)), 1)
+        bproj = plan.proj_all[members][:, :s].astype(np.int32)
+        bucket_host.append(dict(
+            cap=cap,
+            members=members.astype(np.int32),
+            brow=brow,
+            counts=counts_b.astype(np.int32),
+            proj=bproj,
+            intercepts=plan.intercept_slots_all[members],
+            rows_flat=rows_flat,
+            t_of=t_of,
+            r_of=r_of,
+        ))
 
-    max_sub_dim = int(sub_dims.max()) if num_entities else 1
-    max_sub_dim = max(max_sub_dim, 1)
-    proj_all = np.full((num_entities, max_sub_dim), -1, dtype=np.int64)
-    for e in range(num_entities):
-        proj_all[e, : sub_dims[e]] = projs[e]
+    ell_idx = ell_val = ell_tail = None
+    if not lazy:
+        ell_idx, ell_val, _ = game_data.host_shard_coo(
+            config.feature_shard_id
+        )
+        ell_tail = game_data.host_shard_tail(config.feature_shard_id)
+    labels_np = game_data.host_column("labels")
+    offsets_np = game_data.host_column("offsets")
+    weights_np = game_data.host_column("weights")
 
-    # --- 3. size-bucketed training blocks ---------------------------------
-    caps = sorted(config.bucket_caps)
-    active_ids = np.nonzero(active)[0]
-    bucket_of: dict[int, list[int]] = {}
-    for e in active_ids:
-        r = entity_rows[e].size
-        # Entities above the largest cap round up to the next power of two so
-        # heavy-tailed size distributions share padded shapes (and jit
-        # compiles of the solver) instead of one shape per distinct size.
-        cap = next((c for c in caps if r <= c), 1 << (r - 1).bit_length())
-        bucket_of.setdefault(cap, []).append(int(e))
+    if lazy:
+        # ONE batched device_put for every plan array of every bucket.
+        flat: list[np.ndarray] = []
+        for bh in bucket_host:
+            flat += [bh["members"], bh["brow"], bh["counts"], bh["proj"],
+                     bh["intercepts"]]
+        proj_dev_np = plan.proj_all.astype(np.int32)
+        flat.append(proj_dev_np)
+        devs = jax.device_put(flat)
+        blocks = []
+        for i, bh in enumerate(bucket_host):
+            m, brow, cnt, proj, ints = devs[5 * i:5 * i + 5]
+            blocks.append(BlockPlan(
+                entity_codes=m,
+                row_ids=brow,
+                row_counts=cnt,
+                proj=proj,
+                intercept_slots=ints,
+                raw=feats,
+                raw_labels=game_data.labels,
+                raw_offsets=game_data.offsets,
+                raw_weights=game_data.weights,
+            ))
+        return RandomEffectDataset(
+            config=config,
+            num_entities=num_entities,
+            entity_keys=tag.inverse,
+            blocks=tuple(blocks),
+            max_sub_dim=plan.max_sub_dim,
+            sub_dims=plan.sub_dims,
+            proj_all=plan.proj_all,
+            num_features=plan.num_features,
+            dtype=dtype,
+            score_codes=tag.codes,
+            raw=feats,
+            proj_dev=devs[-1],
+            block_codes_np=tuple(bh["members"] for bh in bucket_host),
+            block_intercepts_np=tuple(
+                bh["intercepts"] for bh in bucket_host
+            ),
+        )
 
+    # ---- materialized layout (DualEll shards, introspection) -------------
     blocks = []
-    for cap in sorted(bucket_of):
-        members = bucket_of[cap]
-        b = len(members)
-        s = max(int(sub_dims[members].max()), 1)
-        # Per-bucket ELL capacity: the widest row among members.
-        k = 1
-        for e in members:
-            rows = entity_rows[e]
-            k = max(k, int((ell_val[rows] != 0.0).sum(axis=1).max(initial=0)))
+    for bh in bucket_host:
+        members = bh["members"]
+        b, cap = bh["brow"].shape
+        rows_flat, t_of, r_of = bh["rows_flat"], bh["t_of"], bh["r_of"]
+        s = bh["proj"].shape[1]
+        # Remap every member row's ELL entries in one vectorized pass
+        # (dual-ELL tails widen only to this bucket's own widest row).
+        wi, wv = _subset_rows_widened(ell_idx, ell_val, ell_tail, rows_flat)
+        slot, found = plan.table.lookup(plan.codes[rows_flat][:, None], wi)
+        found = found & (wv != 0.0)
+        k = max(int(found.sum(axis=1).max(initial=0)), 1)
+        ri, rv = _compact_left(slot, wv, found, k)
         bi = np.zeros((b, cap, k), dtype=np.int32)
         bv = np.zeros((b, cap, k), dtype=ell_val.dtype)
+        bi[t_of, r_of] = ri
+        bv[t_of, r_of] = rv
         bl = np.zeros((b, cap), dtype=labels_np.dtype)
         bo = np.zeros((b, cap), dtype=offsets_np.dtype)
         bw = np.zeros((b, cap), dtype=weights_np.dtype)
-        brow = np.zeros((b, cap), dtype=np.int32)
-        bproj = np.full((b, s), -1, dtype=np.int32)
-        bint = np.full(b, -1, dtype=np.int32)
-        remap = np.full(num_features, -1, dtype=np.int64)  # reused buffer
-        for t, e in enumerate(members):
-            rows = entity_rows[e]
-            act = projs[e]
-            remap[act] = np.arange(act.size)
-            bproj[t, : act.size] = act
-            if intercept_index is not None and remap[intercept_index] >= 0:
-                bint[t] = remap[intercept_index]
-            r = rows.size
-            bi[t, :r], bv[t, :r] = _remap_ell_rows(
-                ell_idx[rows], ell_val[rows], remap, k
-            )
-            bl[t, :r] = labels_np[rows]
-            bo[t, :r] = offsets_np[rows]
-            bw[t, :r] = weights_np[rows]
-            brow[t, :r] = rows
-            remap[act] = -1
-        slot = np.arange(s)[None, :]
-        valid = (slot < sub_dims[members][:, None]).astype(np.float32)
+        brow_arr = bh["brow"]
+        bl[t_of, r_of] = labels_np[rows_flat]
+        bo[t_of, r_of] = offsets_np[rows_flat]
+        bw[t_of, r_of] = weights_np[rows_flat]
+        bint = bh["intercepts"]
+        slot_iota = np.arange(s)[None, :]
+        valid = (slot_iota < plan.sub_dims[members][:, None]).astype(
+            np.float32
+        )
         penalty = valid.copy()
         has_int = bint >= 0
         penalty[has_int, bint[has_int]] = 0.0
-        blocks.append(
-            EntityBlocks(
-                entity_codes=jnp.asarray(np.asarray(members, dtype=np.int32)),
-                x_indices=jnp.asarray(bi),
-                x_values=jnp.asarray(bv, dtype=dtype),
-                labels=jnp.asarray(bl, dtype=dtype),
-                offsets=jnp.asarray(bo, dtype=dtype),
-                weights=jnp.asarray(bw, dtype=dtype),
-                row_ids=jnp.asarray(brow),
-                proj=jnp.asarray(bproj),
-                penalty_mask=jnp.asarray(penalty, dtype=dtype),
-                valid_mask=jnp.asarray(valid, dtype=dtype),
-                intercept_slots=jnp.asarray(bint),
-            )
-        )
+        blocks.append(EntityBlocks(
+            entity_codes=jnp.asarray(members),
+            x_indices=jnp.asarray(bi),
+            x_values=jnp.asarray(bv, dtype=dtype),
+            labels=jnp.asarray(bl, dtype=dtype),
+            offsets=jnp.asarray(bo, dtype=dtype),
+            weights=jnp.asarray(bw, dtype=dtype),
+            row_ids=jnp.asarray(brow_arr),
+            proj=jnp.asarray(bh["proj"]),
+            penalty_mask=jnp.asarray(penalty, dtype=dtype),
+            valid_mask=jnp.asarray(valid, dtype=dtype),
+            intercept_slots=jnp.asarray(bint),
+        ))
 
-    # --- 4. full-table scoring arrays (active + passive rows) -------------
-    si, sv, tail = _build_score_table(
-        codes.astype(np.int64),
-        ell_idx,
-        ell_val,
-        lambda e: projs[e],
-        num_entities,
-        num_features,
-        sort=(perm, starts, ends),  # reuse the (entity, hash) lexsort
-        width_cap=config.score_table_width_cap,
+    si, sv, tail = _score_table_arrays(
+        plan.codes, ell_idx, ell_val, plan.table,
+        config.score_table_width_cap, tail_in=ell_tail,
     )
     tail_r = tail_i = tail_v = None
     if tail is not None:
@@ -585,14 +1068,17 @@ def build_random_effect_dataset(
         num_entities=num_entities,
         entity_keys=tag.inverse,
         blocks=tuple(blocks),
-        score_codes=jnp.asarray(codes.astype(np.int32)),
+        max_sub_dim=plan.max_sub_dim,
+        sub_dims=plan.sub_dims,
+        proj_all=plan.proj_all,
+        num_features=plan.num_features,
+        dtype=dtype,
+        score_codes=jnp.asarray(plan.codes.astype(np.int32)),
         score_indices=jnp.asarray(si),
         score_values=jnp.asarray(sv, dtype=dtype),
-        max_sub_dim=max_sub_dim,
-        sub_dims=sub_dims,
-        proj_all=proj_all,
-        num_features=num_features,
         score_tail_rows=tail_r,
         score_tail_indices=tail_i,
         score_tail_values=tail_v,
+        block_codes_np=tuple(bh["members"] for bh in bucket_host),
+        block_intercepts_np=tuple(bh["intercepts"] for bh in bucket_host),
     )

@@ -185,9 +185,10 @@ def read_merged(
     for shard in feature_shards:
         indices, values = rows_to_ell(
             shard_rows[shard], len(out_maps[shard]))
+        # Numpy-backed: make_game_dataset keeps the host mirror (the
+        # dataset-build planner reads it) and pushes the device copy once.
         shards[shard] = SparseFeatures(
-            jnp.asarray(indices), jnp.asarray(values, dtype=dtype),
-            len(out_maps[shard]))
+            indices, values, len(out_maps[shard]))
     game = make_game_dataset(
         labels,
         shards,

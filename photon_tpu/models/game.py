@@ -80,6 +80,13 @@ class RandomEffectModel:
         return score_entity_table(self.coefficients, codes, indices, values)
 
     def score_dataset(self, dataset: RandomEffectDataset) -> Array:
+        if dataset.is_lazy:
+            return score_raw_features(
+                self.coefficients,
+                dataset.score_codes,
+                dataset.raw,
+                dataset.proj_dev,
+            )
         tail = None
         if dataset.score_tail_rows is not None:
             tail = (
@@ -108,6 +115,86 @@ def score_entity_table(
     rows = jnp.take(w, codes, axis=0)  # [n, S]
     picked = jnp.take_along_axis(rows, indices, axis=-1)  # [n, k]
     return jnp.sum(values * picked, axis=-1)
+
+
+@jax.jit
+def _score_raw_dense(w: Array, codes: Array, x: Array, proj: Array) -> Array:
+    """Fused dense-shard scoring: scatter each entity's subspace
+    coefficients into original feature space ([E, d], small), then one
+    gather-dot per row against the HBM-resident raw matrix. No [n, k]
+    scoring table ever exists."""
+    e, s = w.shape
+    d = x.shape[1]
+    # -1 projector pads scatter into a spill column that is sliced away.
+    pr = jnp.where(proj >= 0, proj, d)
+    w_orig = jnp.zeros((e, d + 1), w.dtype)
+    w_orig = w_orig.at[
+        jnp.arange(e, dtype=jnp.int32)[:, None], pr
+    ].set(jnp.where(proj >= 0, w, 0.0))[:, :d]
+    # Unseen entities (code -1) drop to zero rows. NOTE: jnp.take wraps
+    # negative indices numpy-style BEFORE the out-of-bounds fill check, so
+    # -1 must be masked explicitly, not left to mode="fill".
+    rows = jnp.take(
+        w_orig, jnp.maximum(codes, 0), axis=0, mode="fill", fill_value=0
+    )
+    rows = jnp.where((codes >= 0)[:, None], rows, 0)
+    return jnp.sum(x.astype(w.dtype) * rows, axis=-1)
+
+
+@jax.jit
+def _score_raw_sparse(
+    w: Array, codes: Array, indices: Array, values: Array, proj: Array
+) -> Array:
+    """Fused ELL-shard scoring: per-row binary search into the owning
+    entity's sorted projector resolves each feature to its subspace slot;
+    the coefficient gather and multiply-reduce fuse behind it."""
+    s = w.shape[1]
+    sentinel = jnp.iinfo(jnp.int32).max
+    psort = jnp.where(proj >= 0, proj, sentinel)  # [E, S], stays ascending
+    # Unseen entities (code -1): jnp.take wraps negative indices
+    # numpy-style before the fill check, so mask them explicitly.
+    safe = jnp.maximum(codes, 0)
+    known = (codes >= 0)[:, None]
+    prows = jnp.take(
+        psort, safe, axis=0, mode="fill", fill_value=sentinel
+    )  # [n, S]
+    slot = jax.vmap(jnp.searchsorted)(prows, indices)
+    slot = jnp.minimum(slot, s - 1)
+    hit = (jnp.take_along_axis(prows, slot, axis=1) == indices) & known
+    wrows = jnp.take(w, safe, axis=0, mode="fill", fill_value=0)  # [n, S]
+    picked = jnp.take_along_axis(wrows, slot, axis=1)
+    return jnp.sum(jnp.where(hit, values * picked, 0.0), axis=-1)
+
+
+def score_raw_features(
+    w: Array, codes: Array, feats, proj_dev: Array
+) -> Array:
+    """Lazy-layout scoring straight off the raw feature arrays.
+
+    The materialized equivalent (``score_entity_table``) reads a
+    pre-remapped [n, k] table; this fuses the remap into the score so the
+    only per-row state in HBM is the raw shard itself (shared with every
+    other consumer). ``proj_dev`` is the device [E, S] projector matrix.
+    """
+    from photon_tpu.data.dataset import DenseFeatures, SparseFeatures
+
+    if w.shape[0] == 0:
+        n = (
+            feats.x.shape[0]
+            if isinstance(feats, DenseFeatures)
+            else feats.indices.shape[0]
+        )
+        return jnp.zeros(n, dtype=w.dtype)
+    if isinstance(feats, DenseFeatures):
+        return _score_raw_dense(w, codes, feats.x, proj_dev)
+    if isinstance(feats, SparseFeatures):
+        return _score_raw_sparse(
+            w, codes, feats.indices, feats.values, proj_dev
+        )
+    raise TypeError(
+        f"lazy scoring expects Dense or Sparse features, got "
+        f"{type(feats).__name__}"
+    )
 
 
 def score_entity_table_with_tail(

@@ -35,21 +35,25 @@ def shard_random_effect_dataset(
     """Shard a RandomEffectDataset's entity axis over the mesh (ep).
 
     Each size bucket's entity axis is padded to a multiple of the device
-    count with inert entities (weight 0, empty subspace, entity code ==
-    num_entities so their scatter back into the coefficient matrix is
-    dropped as out-of-bounds), then every block leaf is placed with its
-    leading axis sharded. The per-entity solves are embarrassingly parallel
-    (RandomEffectCoordinate.scala:243-292 runs them executor-local), so
-    sharding the vmapped solver's batch axis keeps all solver FLOPs local
-    to each device — the TPU analog of the reference's entity partitioning
-    (RandomEffectDatasetPartitioner.scala:44).
+    count with inert entities (weight 0 / row_count 0, empty subspace,
+    entity code == num_entities so their scatter back into the coefficient
+    matrix is dropped as out-of-bounds), then every block leaf is placed
+    with its leading axis sharded. The per-entity solves are embarrassingly
+    parallel (RandomEffectCoordinate.scala:243-292 runs them
+    executor-local), so sharding the vmapped solver's batch axis keeps all
+    solver FLOPs local to each device — the TPU analog of the reference's
+    entity partitioning (RandomEffectDatasetPartitioner.scala:44).
 
-    The scoring table's row axis is sharded too when evenly divisible
-    (otherwise left as-is: scoring is one gather-multiply-reduce either way).
+    Lazy ``BlockPlan`` buckets shard their plan arrays on the entity axis;
+    the shared raw leaves are replicated over the mesh (each device gathers
+    its own entities' rows locally — the replication rides ICI once, and is
+    the memory-for-zero-shuffle tradeoff the reference pays per iteration
+    in shuffles instead). The materialized scoring table's row axis is
+    sharded when evenly divisible.
     """
     import dataclasses
 
-    from photon_tpu.data.random_effect import EntityBlocks
+    from photon_tpu.data.random_effect import BlockPlan, EntityBlocks
 
     n_dev = mesh.shape[axis_name]
 
@@ -58,29 +62,89 @@ def shard_random_effect_dataset(
             leaf, row_sharding(mesh, np.ndim(leaf), axis_name=axis_name)
         )
 
+    def replicate(leaf):
+        return jax.device_put(leaf, NamedSharding(mesh, P()))
+
     import jax.numpy as jnp
 
-    def pad_block(b: EntityBlocks) -> EntityBlocks:
+    _rep_cache: dict[int, object] = {}
+
+    def replicate_cached(leaf):
+        got = _rep_cache.get(id(leaf))
+        if got is None:
+            got = jax.tree.map(replicate, leaf)
+            _rep_cache[id(leaf)] = got
+        return got
+
+    fills = {"entity_codes": ds.num_entities,
+             "proj": -1, "intercept_slots": -1}
+    plan_fields = (
+        "entity_codes", "row_ids", "row_counts", "proj", "intercept_slots"
+    )
+
+    def pad_leaf(name, leaf, pad):
+        widths = [(0, pad)] + [(0, 0)] * (np.ndim(leaf) - 1)
+        return jnp.pad(leaf, widths, constant_values=fills.get(name, 0))
+
+    codes_np, ints_np = [], []
+
+    def pad_host_mirror(arr, pad, fill):
+        a = np.asarray(arr)
+        return np.pad(a, (0, pad), constant_values=fill) if pad else a
+
+    def pad_block(i, b):
         pad = (-b.num_entities) % n_dev
+        # Host mirrors are padded host-side (never pulled from the device:
+        # on a multi-host mesh the placed arrays span non-addressable
+        # devices and cannot be fetched back).
+        codes_np.append(
+            pad_host_mirror(ds.block_codes_np[i], pad, ds.num_entities)
+        )
+        ints_np.append(pad_host_mirror(ds.block_intercepts_np[i], pad, -1))
+        if isinstance(b, BlockPlan):
+            vals = {
+                name: pad_leaf(name, getattr(b, name), pad) if pad
+                else getattr(b, name)
+                for name in plan_fields
+            }
+            vals = {k: place(v) for k, v in vals.items()}
+            return dataclasses.replace(
+                b,
+                raw=replicate_cached(b.raw),
+                raw_labels=replicate_cached(b.raw_labels),
+                raw_offsets=replicate_cached(b.raw_offsets),
+                raw_weights=replicate_cached(b.raw_weights),
+                **vals,
+            )
         if pad:
-            fills = {"entity_codes": ds.num_entities,
-                     "proj": -1, "intercept_slots": -1}
-
-            def pad_leaf(name, leaf):
-                widths = [(0, pad)] + [(0, 0)] * (np.ndim(leaf) - 1)
-                return jnp.pad(
-                    leaf, widths, constant_values=fills.get(name, 0)
-                )
-
             b = EntityBlocks(**{
-                f.name: pad_leaf(f.name, getattr(b, f.name))
+                f.name: pad_leaf(f.name, getattr(b, f.name), pad)
                 for f in dataclasses.fields(EntityBlocks)
             })
         return jax.tree.map(place, b)
 
-    blocks = tuple(pad_block(b) for b in ds.blocks)
-    rep = {"blocks": blocks}
-    if ds.score_codes.shape[0] % n_dev == 0:
+    blocks = tuple(pad_block(i, b) for i, b in enumerate(ds.blocks))
+    rep = {
+        "blocks": blocks,
+        "block_codes_np": tuple(codes_np),
+        "block_intercepts_np": tuple(ints_np),
+    }
+    if ds.is_lazy:
+        # Raw leaves must be replicated (BlockPlans gather arbitrary rows),
+        # but the residual scorer is per-row: sharding score_codes row-wise
+        # (when divisible) makes the fused score dp-parallel — GSPMD slices
+        # the replicated raw operand locally for free.
+        codes = ds.score_codes
+        if codes.shape[0] % n_dev == 0:
+            codes = place(codes)
+        else:
+            codes = replicate(codes)
+        rep.update(
+            raw=replicate_cached(ds.raw),
+            score_codes=codes,
+            proj_dev=replicate_cached(ds.proj_dev),
+        )
+    elif ds.score_codes.shape[0] % n_dev == 0:
         rep.update(
             score_codes=place(ds.score_codes),
             score_indices=place(ds.score_indices),

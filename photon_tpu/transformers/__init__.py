@@ -15,7 +15,7 @@ import dataclasses
 import jax
 
 from photon_tpu.data.game_data import GameDataset
-from photon_tpu.data.random_effect import remap_for_scoring
+from photon_tpu.data.random_effect import remap_for_scoring, scoring_codes
 from photon_tpu.evaluation.evaluators import EvaluatorSpec
 from photon_tpu.evaluation.suite import EvaluationResults, make_suite
 from photon_tpu.models.game import (
@@ -23,6 +23,7 @@ from photon_tpu.models.game import (
     GameModel,
     RandomEffectModel,
     score_entity_table_with_tail,
+    score_raw_features,
 )
 from photon_tpu.parallel.mesh import maybe_row_shard
 
@@ -61,12 +62,49 @@ def random_effect_scorer(
 ):
     """model -> per-row scores for a random-effect sub-model on ``data``.
 
-    The expensive host-side subspace remap happens once at construction;
-    the returned closure is a pure device gather. ``width_cap`` bounds the
-    remapped table's slab width (overflow rides a COO tail). With ``mesh``
-    the remapped table is row-sharded; the COO tail stays replicated (its
-    segment-sum spans rows across shards).
+    Dense/Sparse shards take the lazy path: only the [n] entity codes and
+    the [E, S] projector matrix cross the host->device link; the subspace
+    remap fuses into the jitted score against the HBM-resident raw shard
+    (models/game.py score_raw_features). ``DualEllFeatures`` shards fall
+    back to the materialized remap table, where ``width_cap`` bounds the
+    slab width (overflow rides a COO tail). With ``mesh`` the materialized
+    table is row-sharded; the COO tail stays replicated (its segment-sum
+    spans rows across shards).
     """
+    import numpy as np
+
+    from photon_tpu.data.dataset import DenseFeatures, SparseFeatures
+
+    feats = data.feature_shards[feature_shard_id]
+    # A width cap opts out of the lazy path: its [n, S] gather intermediates
+    # would recreate the width hazard the cap exists to bound.
+    if width_cap is None and isinstance(
+        feats, (DenseFeatures, SparseFeatures)
+    ):
+        codes_np = scoring_codes(data, re_type, entity_keys).astype(np.int32)
+        codes, proj_dev = jax.device_put(
+            [codes_np, np.asarray(proj_all).astype(np.int32)]
+        )
+        if mesh is not None:
+            # Row-shard the per-row operands (dp scoring); the projector
+            # matrix and coefficients stay replicated.
+            from photon_tpu.parallel.mesh import replicated
+
+            if isinstance(feats, DenseFeatures):
+                codes, x = maybe_row_shard(mesh, codes, feats.x)
+                feats = DenseFeatures(x)
+            else:
+                codes, idx_s, val_s = maybe_row_shard(
+                    mesh, codes, feats.indices, feats.values
+                )
+                feats = SparseFeatures(idx_s, val_s, feats.d)
+            proj_dev = jax.device_put(proj_dev, replicated(mesh))
+
+        def scorer(m: RandomEffectModel) -> Array:
+            return score_raw_features(m.coefficients, codes, feats, proj_dev)
+
+        return scorer
+
     codes, idx, vals, tail = remap_for_scoring(
         data,
         re_type=re_type,

@@ -97,12 +97,15 @@ class TestRandomEffectDataset:
         assert ds.num_active_entities == int((counts >= 3).sum())
 
     def test_scoring_table_matches_raw_features(self, rng):
-        """With no feature filtering, the subspace-remapped scoring table must
-        reproduce x . w_e exactly for a model whose subspace rows carry the
-        entity's coefficients."""
+        """With no feature filtering, the subspace-remapped scoring — both
+        the materialized table and the lazy fused path — must reproduce
+        x . w_e exactly for a model whose subspace rows carry the entity's
+        coefficients."""
         game, entities = _toy_game_dataset(rng)
         cfg = RandomEffectDataConfiguration("userId", "shard")
-        ds = build_random_effect_dataset(game, cfg, intercept_index=5)
+        ds = build_random_effect_dataset(
+            game, cfg, intercept_index=5, lazy=False
+        )
 
         # Coefficient matrix in subspace layout from a dense random matrix.
         w_full = rng.normal(size=(ds.num_entities, 6))
@@ -111,7 +114,10 @@ class TestRandomEffectDataset:
             for s, f in enumerate(ds.proj_all[e]):
                 if f >= 0:
                     w_sub[e, s] = w_full[e, f]
-        from photon_tpu.models.game import score_entity_table
+        from photon_tpu.models.game import (
+            score_entity_table,
+            score_raw_features,
+        )
 
         z = score_entity_table(
             jnp.asarray(w_sub),
@@ -123,6 +129,19 @@ class TestRandomEffectDataset:
         codes = np.asarray(game.id_tags["userId"].codes)
         expected = np.einsum("nd,nd->n", x, w_full[codes])
         np.testing.assert_allclose(np.asarray(z), expected, rtol=1e-6)
+
+        # Lazy layout: same scores, fused against the raw shard.
+        ds_lazy = build_random_effect_dataset(
+            game, cfg, intercept_index=5
+        )
+        assert ds_lazy.is_lazy
+        z_lazy = score_raw_features(
+            jnp.asarray(w_sub),
+            ds_lazy.score_codes,
+            ds_lazy.raw,
+            ds_lazy.proj_dev,
+        )
+        np.testing.assert_allclose(np.asarray(z_lazy), expected, rtol=1e-6)
 
     def test_pearson_filter_keeps_intercept(self, rng):
         game, _ = _toy_game_dataset(rng, n=120, num_entities=3)

@@ -109,10 +109,12 @@ class TestScoreTableWidthCap:
             dtype=jnp.float64,
         )
         full = build_random_effect_dataset(
-            game, RandomEffectDataConfiguration("userId", "shard"))
+            game, RandomEffectDataConfiguration("userId", "shard"),
+            lazy=False)
         capped = build_random_effect_dataset(
             game, RandomEffectDataConfiguration(
-                "userId", "shard", score_table_width_cap=3))
+                "userId", "shard", score_table_width_cap=3),
+            lazy=False)
         assert capped.score_values.shape[1] == 3
         assert capped.score_tail_rows is not None
         assert capped.score_tail_rows.shape[0] > 0
@@ -133,6 +135,13 @@ class TestScoreTableWidthCap:
         s_full = np.asarray(model(full).score_dataset(full))
         s_capped = np.asarray(model(capped).score_dataset(capped))
         np.testing.assert_allclose(s_capped, s_full, rtol=1e-10)
+
+        # The lazy fused path must agree with the materialized table too.
+        lazy = build_random_effect_dataset(
+            game, RandomEffectDataConfiguration("userId", "shard"))
+        assert lazy.is_lazy
+        s_lazy = np.asarray(model(lazy).score_dataset(lazy))
+        np.testing.assert_allclose(s_lazy, s_full, rtol=1e-10)
 
 
 class TestFeatureAxisSharding:
@@ -304,3 +313,62 @@ def test_validation_scorer_width_cap_parity(rng):
     s_capped = np.asarray(
         random_effect_scorer(val, width_cap=2, **kw)(model))
     np.testing.assert_allclose(s_capped, s_full, rtol=1e-10)
+
+
+class TestDualEllRandomEffect:
+    def test_dual_ell_shard_trains_and_scores_like_sparse(self, rng):
+        """A random-effect coordinate over a DualEllFeatures shard (the
+        materialized fallback path, incl. the host slab+tail view) must
+        produce the same model and scores as the same data in plain ELL."""
+        from photon_tpu.algorithm.random_effect import RandomEffectCoordinate
+        from photon_tpu.data.game_data import make_game_dataset
+        from photon_tpu.data.random_effect import (
+            RandomEffectDataConfiguration,
+            build_random_effect_dataset,
+        )
+
+        n, d, E = 120, 30, 6
+        idx, val = _random_ell(rng, n, d, k_max=4, heavy_rows=4, heavy_k=20)
+        y = rng.normal(size=n)
+        entities = rng.integers(0, E, size=n)
+        dual = ell_to_dual_ell(idx, val, d, width_cap=4, dtype=np.float64)
+        assert dual.tail_values.shape[0] > 0
+        game_dual = make_game_dataset(
+            y, {"shard": dual},
+            id_tags={"userId": entities}, dtype=jnp.float64,
+        )
+        game_sparse = make_game_dataset(
+            y, {"shard": SparseFeatures(idx, val, d)},
+            id_tags={"userId": entities}, dtype=jnp.float64,
+        )
+        cfg = RandomEffectDataConfiguration(
+            "userId", "shard", score_table_width_cap=4
+        )
+        ds_dual = build_random_effect_dataset(game_dual, cfg)
+        assert not ds_dual.is_lazy  # DualEll -> materialized fallback
+        ds_sparse = build_random_effect_dataset(game_sparse, cfg, lazy=False)
+        # Identical projectors from slab + tail union.
+        np.testing.assert_array_equal(ds_dual.proj_all, ds_sparse.proj_all)
+
+        conf = GLMOptimizationConfiguration(
+            regularization=L2, regularization_weight=0.5
+        )
+        m_dual, _ = RandomEffectCoordinate(
+            ds_dual, TaskType.LINEAR_REGRESSION, conf
+        ).train()
+        m_sparse, _ = RandomEffectCoordinate(
+            ds_sparse, TaskType.LINEAR_REGRESSION, conf
+        ).train()
+        np.testing.assert_allclose(
+            np.asarray(m_dual.coefficients),
+            np.asarray(m_sparse.coefficients),
+            rtol=1e-8, atol=1e-10,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_dual.score_dataset(ds_dual)),
+            np.asarray(m_sparse.score_dataset(ds_sparse)),
+            rtol=1e-8, atol=1e-10,
+        )
+        # Host slab view stays width-bounded (no re-widening to max row).
+        si, sv, dd = game_dual.host_shard_coo("shard")
+        assert si.shape[1] == 4
