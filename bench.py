@@ -3,22 +3,34 @@
 Workload: synthetic MovieLens-shaped GLMix — a dense global fixed effect plus
 per-user and per-movie random effects with NON-TRIVIAL per-entity feature
 shards (17-dim user shard, 9-dim movie shard, matching the reference's
-userShard/songShard design in the Yahoo! Music config), squared loss, trained
-by block coordinate descent (global L-BFGS solve + vmapped per-entity bucket
-solves).
+userShard/songShard design in the Yahoo! Music config), trained by block
+coordinate descent. Two task variants run:
 
-Two phases are measured separately (the reference's Timed sections around
+- **squared loss** (the headline): global L-BFGS solve + exact vmapped
+  per-entity Cholesky solves — the MovieLens GLMix configuration;
+- **logistic**: same structure with binarized labels and iterative vmapped
+  per-entity L-BFGS — the a1a-style binary GLMix configuration.
+
+Phases are measured separately (the reference's Timed sections around
 prepareTrainingDatasets vs CoordinateDescent.run):
-- **ingest**: host-side dataset build (entity bucketing, subspace
-  projectors, scoring-table remap) + first-compile, reported as
-  ``ingest_seconds`` / ``compile_seconds`` context fields;
+- **ingest**: host-side dataset planning + small plan pushes;
+- **compile**: the first fit (tracing + XLA compiles; a persistent
+  compilation cache makes repeat processes much cheaper);
 - **train**: steady-state coordinate descent on device — the headline
   ``rows/s`` metric (dataset rows x CD iterations / wall-clock).
 
-``vs_baseline`` divides by a frozen anchor: the reference publishes no
-wall-clock numbers anywhere (see BASELINE.md), so the anchor is a nominal
-Spark-local-equivalent constant fixed in round 1; cross-round movement of
-this ratio is the signal.
+HONESTY NOTES (all in the output line):
+- ``vs_baseline`` divides by a frozen NOMINAL anchor (50k rows/s,
+  "Spark-local-equivalent", fixed in round 1). The reference publishes no
+  wall-clock numbers anywhere (BASELINE.md), so this ratio's only valid use
+  is cross-round movement; it does NOT measure the BASELINE.md north star
+  (>= 4x vs Spark-on-16xA100 measured).
+- ``model_flops_per_sec`` is an analytic lower-bound count of the USEFUL
+  model FLOPs (matvecs, normal equations, Cholesky, scoring) from the run's
+  actual iteration diagnostics, divided by train wall-clock; padding and
+  overhead FLOPs are excluded. ``fraction_of_bf16_peak`` divides by the
+  chip's bf16 peak (v5e: 197 TFLOP/s) — GLM workloads are tiny-matrix and
+  bandwidth-bound, so this is expected to be far below 1.
 
 Prints exactly ONE JSON line.
 """
@@ -28,10 +40,11 @@ import time
 
 import numpy as np
 
-# Frozen round-1 anchor (see module docstring). Nominal Spark local[*]
+# Frozen round-1 anchor (see HONESTY NOTES). Nominal Spark local[*]
 # throughput on a comparable GLMix workload; the reference repo itself
 # publishes no benchmark numbers.
 ANCHOR_ROWS_PER_SEC = 50_000.0
+PEAK_BF16_FLOPS = 197e12  # TPU v5e per-chip bf16 peak
 
 N_ROWS = 100_000
 N_FEATURES = 64
@@ -42,7 +55,7 @@ N_MOVIES = 500
 CD_ITERATIONS = 2
 
 
-def build_data():
+def build_data(task="linear"):
     from photon_tpu.data.dataset import DenseFeatures
     from photon_tpu.data.game_data import make_game_dataset
 
@@ -58,12 +71,17 @@ def build_data():
     w = rng.normal(size=N_FEATURES).astype(np.float32) * 0.3
     wu = rng.normal(size=(N_USERS, N_USER_FEATURES + 1)).astype(np.float32) * 0.3
     wm = rng.normal(size=(N_MOVIES, N_MOVIE_FEATURES + 1)).astype(np.float32) * 0.2
-    y = (
+    z = (
         x @ w
         + np.einsum("nd,nd->n", xu, wu[users])
         + np.einsum("nd,nd->n", xm, wm[movies])
-        + 0.2 * rng.normal(size=N_ROWS).astype(np.float32)
     )
+    if task == "logistic":
+        y = (
+            rng.uniform(size=N_ROWS) < 1.0 / (1.0 + np.exp(-0.5 * z))
+        ).astype(np.float32)
+    else:
+        y = z + 0.2 * rng.normal(size=N_ROWS).astype(np.float32)
     # Numpy-backed shards: make_game_dataset pushes the device copy once and
     # keeps host mirrors for the (host-side) dataset-build planner.
     return make_game_dataset(
@@ -77,7 +95,7 @@ def build_data():
     )
 
 
-def build_estimator():
+def build_estimator(task_name="linear"):
     from photon_tpu import optim
     from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
     from photon_tpu.data.random_effect import RandomEffectDataConfiguration
@@ -96,8 +114,13 @@ def build_estimator():
             regularization_weight=w,
         )
 
+    task = (
+        TaskType.LOGISTIC_REGRESSION
+        if task_name == "logistic"
+        else TaskType.LINEAR_REGRESSION
+    )
     return GameEstimator(
-        TaskType.LINEAR_REGRESSION,
+        task,
         {
             "global": FixedEffectCoordinateConfiguration("global", l2(1e-3)),
             "per-user": RandomEffectCoordinateConfiguration(
@@ -122,40 +145,113 @@ def build_estimator():
     )
 
 
-def main():
-    data = build_data()
-    est = build_estimator()
+def estimate_model_flops(result, datasets, task_name) -> float:
+    """Analytic USEFUL-FLOP count of one fit, from its actual diagnostics.
 
-    # Phase 1 — ingest: host-side dataset build, measured alone (primes the
-    # estimator's cache so later fits skip it).
+    Counted per coordinate update (CoordinateUpdateRecord):
+    - fixed effect: iters x (value+grad = 2 matvecs) = iters * 4 n d;
+    - random effect, direct: per entity 2 r S^2 (normal equations) +
+      S^3/3 (Cholesky), summed over kept rows;
+    - random effect, iterative: mean_iters x 4 r S per entity;
+    - scoring after each update: 2 n d_coord.
+    Padding rows/slots are excluded — this is model work, not device work.
+    """
+    from photon_tpu.algorithm.random_effect import (
+        RandomEffectTrainingStats,
+    )
+
+    flops = 0.0
+    for rec in result.descent.history:
+        cid = rec.coordinate_id
+        diag = rec.diagnostics
+        if cid == "global":
+            iters = float(np.asarray(getattr(diag, "iterations", 100)))
+            flops += iters * 4.0 * N_ROWS * N_FEATURES
+            flops += 2.0 * N_ROWS * N_FEATURES  # scoring pass
+            continue
+        ds = datasets[cid]
+        s = ds.max_sub_dim
+        kept = float(np.minimum(
+            np.bincount(
+                np.asarray(ds.score_codes), minlength=ds.num_entities
+            ),
+            ds.config.active_data_upper_bound or np.iinfo(np.int64).max,
+        ).sum())
+        if isinstance(diag, RandomEffectTrainingStats):
+            # The solver choice is static: squared loss + pure L2 takes the
+            # exact Cholesky path; everything else iterates.
+            if task_name == "linear":
+                flops += 2.0 * kept * s * s + ds.num_entities * (s ** 3) / 3.0
+            else:
+                flops += diag.iterations_mean * 4.0 * kept * s
+        flops += 2.0 * N_ROWS * s  # scoring pass
+    return flops
+
+
+def run_variant(task_name):
+    data = build_data(task_name)
+    est = build_estimator(task_name)
+
     t0 = time.perf_counter()
-    est.prepare(data)
+    datasets, _ = est.prepare(data)
     ingest_seconds = time.perf_counter() - t0
 
-    # Phase 2 — compile: first fit warms XLA's caches.
     t0 = time.perf_counter()
     est.fit(data)
     compile_seconds = time.perf_counter() - t0
 
-    # Phase 3 — steady-state train (the headline metric): best of 3 to damp
-    # remote-device jitter.
     train_seconds = float("inf")
+    result = None
     for _ in range(3):
         t0 = time.perf_counter()
-        est.fit(data)
+        result = est.fit(data)[0]
         train_seconds = min(train_seconds, time.perf_counter() - t0)
 
-    rows_per_sec = N_ROWS * CD_ITERATIONS / train_seconds
-    print(json.dumps({
+    flops = estimate_model_flops(result, datasets, task_name)
+    return dict(
+        ingest_seconds=ingest_seconds,
+        compile_seconds=compile_seconds,
+        train_seconds=train_seconds,
+        rows_per_sec=N_ROWS * CD_ITERATIONS / train_seconds,
+        model_flops_per_sec=flops / train_seconds,
+    )
+
+
+def main():
+    from photon_tpu.utils import enable_compilation_cache
+
+    # Persistent XLA compile cache: cold runs pay compile_seconds once per
+    # machine; repeat runs (and re-runs across rounds) hit the disk cache.
+    enable_compilation_cache()
+
+    lin = run_variant("linear")
+    logi = run_variant("logistic")
+
+    out = {
         "metric": "glmix_e2e_train_throughput",
-        "value": round(rows_per_sec, 1),
+        "value": round(lin["rows_per_sec"], 1),
         "unit": "rows/s",
-        "vs_baseline": round(rows_per_sec / ANCHOR_ROWS_PER_SEC, 3),
-        "train_seconds": round(train_seconds, 3),
-        "ingest_seconds": round(ingest_seconds, 3),
-        "compile_seconds": round(compile_seconds, 3),
-        "ingest_rows_per_sec": round(N_ROWS / ingest_seconds, 1),
-    }))
+        # Cross-round movement signal ONLY — nominal anchor, not a measured
+        # reference baseline (see module docstring HONESTY NOTES).
+        "vs_baseline": round(lin["rows_per_sec"] / ANCHOR_ROWS_PER_SEC, 3),
+        "baseline_kind": "nominal-round1-anchor-50k-rows-per-sec",
+        "train_seconds": round(lin["train_seconds"], 3),
+        "ingest_seconds": round(lin["ingest_seconds"], 3),
+        "compile_seconds": round(lin["compile_seconds"], 3),
+        "ingest_rows_per_sec": round(N_ROWS / lin["ingest_seconds"], 1),
+        "e2e_seconds": round(
+            lin["ingest_seconds"] + lin["compile_seconds"]
+            + lin["train_seconds"], 3),
+        "model_flops_per_sec": round(lin["model_flops_per_sec"], 1),
+        "fraction_of_bf16_peak": round(
+            lin["model_flops_per_sec"] / PEAK_BF16_FLOPS, 8),
+        "logistic_rows_per_sec": round(logi["rows_per_sec"], 1),
+        "logistic_train_seconds": round(logi["train_seconds"], 3),
+        "logistic_compile_seconds": round(logi["compile_seconds"], 3),
+        "logistic_model_flops_per_sec": round(
+            logi["model_flops_per_sec"], 1),
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -36,8 +36,13 @@ from photon_tpu.algorithm.problems import (
     VarianceComputationType,
     variances_in_transformed_space,
 )
-from photon_tpu.data.dataset import GLMBatch, SparseFeatures
+from photon_tpu.data.dataset import (
+    DenseFeatures,
+    GLMBatch,
+    SparseFeatures,
+)
 from photon_tpu.data.random_effect import (
+    DENSE_SUB_DIM_MAX,
     BlockPlan,
     EntityBlocks,
     RandomEffectDataset,
@@ -99,9 +104,32 @@ def _coef_to_original(w_t, factors, shifts, int_onehot):
     return w
 
 
+def _features_of(
+    x_indices: Array | None, x_values: Array, sub_dim: int
+):
+    """Per-entity feature view: dense [R, S] matrix or ELL slabs."""
+    if x_indices is None:
+        return DenseFeatures(x_values)
+    return SparseFeatures(x_indices, x_values, sub_dim)
+
+
+def _densify_ell_slots(
+    x_indices: Array, x_values: Array, sub_dim: int
+) -> Array:
+    """[..., k] slot-ELL -> [..., S] dense via one-hot contraction (NOT
+    scatter: batched scatter/gather lowers to a pathologically
+    slow-compiling program on TPU; the one-hot einsum compiles in <1s and
+    runs on the MXU). Duplicate slots sum, matching scatter-add."""
+    onehot = (
+        x_indices[..., None]
+        == jnp.arange(sub_dim, dtype=x_indices.dtype)
+    ).astype(x_values.dtype)
+    return jnp.einsum("...k,...ks->...s", x_values, onehot)
+
+
 def _solve_one_entity_direct(
-    x_indices: Array,  # [R, k]
-    x_values: Array,  # [R, k]
+    x_indices: Array | None,  # [R, k] ELL slots, or None (dense layout)
+    x_values: Array,  # [R, k] or [R, S]
     labels: Array,  # [R]
     offsets: Array,  # [R]
     weights: Array,  # [R]
@@ -131,9 +159,10 @@ def _solve_one_entity_direct(
     by construction — LinearSubspaceProjector compression).
     """
     dtype = x_values.dtype
-    r = x_values.shape[0]
-    rows = jnp.broadcast_to(jnp.arange(r)[:, None], x_indices.shape)
-    x = jnp.zeros((r, sub_dim), dtype).at[rows, x_indices].add(x_values)
+    if x_indices is None:
+        x = x_values
+    else:
+        x = _densify_ell_slots(x_indices, x_values, sub_dim)
     if shifts is not None:
         x = x - shifts[None, :]
     if factors is not None:
@@ -165,7 +194,7 @@ def _solve_one_entity_direct(
     if variance_computation != VarianceComputationType.NONE:
         loss = losses_mod.get_loss(task)
         batch = GLMBatch(
-            SparseFeatures(x_indices, x_values, sub_dim),
+            _features_of(x_indices, x_values, sub_dim),
             labels, offsets, weights,
         )
         var_t = variances_in_transformed_space(
@@ -190,8 +219,8 @@ def _solve_one_entity_direct(
 
 
 def _solve_one_entity(
-    x_indices: Array,  # [R, k]
-    x_values: Array,  # [R, k]
+    x_indices: Array | None,  # [R, k] ELL slots, or None (dense layout)
+    x_values: Array,  # [R, k] or [R, S]
     labels: Array,  # [R]
     offsets: Array,  # [R]
     weights: Array,  # [R]
@@ -220,7 +249,7 @@ def _solve_one_entity(
     tuner retrain) reuses the compiled block solve.
     """
     loss = losses_mod.get_loss(task)
-    feats = SparseFeatures(x_indices, x_values, sub_dim)
+    feats = _features_of(x_indices, x_values, sub_dim)
     batch = GLMBatch(feats, labels, offsets, weights)
     # Per-entity projected normalization; factors/shifts are None (static)
     # when the coordinate has no normalization, so the objective specializes
@@ -331,6 +360,17 @@ def _solve_block(
                 0.0,
             )
     dtype = block.x_values.dtype
+    if block.x_indices is not None and sub_dim <= DENSE_SUB_DIM_MAX:
+        # Densify small-subspace ELL blocks so every downstream op is a
+        # matmul; batched gather/scatter both execute worse and compile
+        # ~40x slower on TPU.
+        block = dataclasses.replace(
+            block,
+            x_indices=None,
+            x_values=_densify_ell_slots(
+                block.x_indices, block.x_values, sub_dim
+            ),
+        )
     s = sub_dim
     codes = block.entity_codes
     proj = block.proj  # [B, S]; -1 pad

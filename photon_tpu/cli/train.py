@@ -41,6 +41,9 @@ def main(argv=None) -> int:
     from photon_tpu.cli.common import cli_logging, maybe_init_distributed
 
     with cli_logging(args.verbose, args.log_file):
+        from photon_tpu.utils import enable_compilation_cache
+
+        enable_compilation_cache()  # persistent XLA cache: warm runs skip compiles
         maybe_init_distributed()
         return _run(args)
 

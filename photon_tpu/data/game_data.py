@@ -91,8 +91,11 @@ class GameDataset:
     # the numpy inputs before pushing them to the device). The dataset-build
     # planner works entirely on these, so ingest never pulls device arrays
     # back over the (potentially slow) host<->device link. Keys:
-    # "labels"/"offsets"/"weights" -> [n] arrays; shard names -> the host
-    # view returned by ``host_shard_coo``.
+    # "labels"/"offsets"/"weights" -> [n] column arrays;
+    # ("shard", <name>) -> the ELL view of ``host_shard_coo``;
+    # ("tail", <name>) -> the COO overflow of ``host_shard_tail``.
+    # Shard names live in their own tuple namespace so a shard named,
+    # say, "weights" cannot clobber the column mirror.
     host: dict | None = None
 
     @property
@@ -118,8 +121,9 @@ class GameDataset:
         overflow entries live in ``host_shard_tail`` (re-widening the slab
         to the widest row would reintroduce exactly the memory hazard the
         dual-ELL layout bounds, SURVEY §7.3)."""
-        if self.host is not None and shard_id in self.host:
-            return self.host[shard_id]
+        key = ("shard", shard_id)
+        if self.host is not None and key in self.host:
+            return self.host[key]
         feats = self.feature_shards[shard_id]
         if isinstance(feats, DenseFeatures):
             x = np.asarray(feats.x)
@@ -136,7 +140,7 @@ class GameDataset:
                 f"{type(feats).__name__}"
             )
         if self.host is not None:
-            self.host[shard_id] = view
+            self.host[key] = view
         return view
 
     def host_shard_tail(self, shard_id: str):
@@ -145,7 +149,7 @@ class GameDataset:
         feats = self.feature_shards[shard_id]
         if not isinstance(feats, DualEllFeatures):
             return None
-        key = (shard_id, "__tail__")
+        key = ("tail", shard_id)
         if self.host is not None and key in self.host:
             return self.host[key]
         tail = (
@@ -211,7 +215,7 @@ def make_game_dataset(
         if isinstance(feats, DenseFeatures) and isinstance(feats.x, np.ndarray):
             x = np.asarray(feats.x, dtype=np_dtype)
             d = x.shape[1]
-            host[name] = (
+            host[("shard", name)] = (
                 np.broadcast_to(np.arange(d, dtype=np.int32), x.shape), x, d,
             )
             feats = DenseFeatures(jnp.asarray(x))
@@ -220,7 +224,7 @@ def make_game_dataset(
         ):
             idx = np.asarray(feats.indices, dtype=np.int32)
             val = np.asarray(feats.values, dtype=np_dtype)
-            host[name] = (idx, val, feats.d)
+            host[("shard", name)] = (idx, val, feats.d)
             feats = SparseFeatures(
                 jnp.asarray(idx), jnp.asarray(val), feats.d
             )

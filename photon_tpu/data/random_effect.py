@@ -101,8 +101,15 @@ class EntityBlocks:
     """
 
     entity_codes: Array  # [B] int32 — global entity code per slot
-    x_indices: Array  # [B, R, k] int32, subspace-remapped
-    x_values: Array  # [B, R, k]
+    # Feature slabs, one of two layouts:
+    # - ELL: x_indices [B, R, k] int32 subspace slots + x_values [B, R, k]
+    # - subspace-dense: x_indices is None, x_values [B, R, S] holds the
+    #   densified per-entity design matrix. Preferred for small sub_dims:
+    #   it keeps every downstream op a matmul (MXU) and avoids batched
+    #   gather/scatter lowerings, which compile catastrophically slowly on
+    #   TPU (tens of seconds per shape vs <1s for the one-hot einsum).
+    x_indices: Array | None
+    x_values: Array  # [B, R, k] or [B, R, S]
     labels: Array  # [B, R]
     offsets: Array  # [B, R] base offsets (residuals added per train call)
     weights: Array  # [B, R]; 0 for padding rows
@@ -119,6 +126,17 @@ class EntityBlocks:
     @property
     def sub_dim(self) -> int:
         return self.proj.shape[-1]
+
+    @property
+    def is_dense(self) -> bool:
+        return self.x_indices is None
+
+
+# Subspace-dense materialization bound: up to this sub_dim the [B, R, S]
+# dense slab (built by one-hot einsum, no gather/scatter) is both the
+# fastest-compiling and the most MXU-friendly layout. Above it, the one-hot
+# tensors get large and blocks stay in ELL form.
+DENSE_SUB_DIM_MAX = 128
 
 
 @jax.tree_util.register_dataclass
@@ -153,10 +171,16 @@ class BlockPlan:
         return self.proj.shape[-1]
 
     def materialize(self, residuals: Array | None = None) -> EntityBlocks:
-        """Gather the bucket's dense training slabs (traceable; runs in jit).
+        """Gather the bucket's training slabs (traceable; runs in jit).
 
         Returns an ``EntityBlocks`` whose ``offsets`` already include the
-        coordinate-descent residuals.
+        coordinate-descent residuals. For sub_dims up to
+        ``DENSE_SUB_DIM_MAX`` the feature slab comes out subspace-DENSE,
+        built by one-hot einsums (comparisons feeding a matmul) — row
+        gathers are plain ``jnp.take``; there is no batched gather/scatter
+        anywhere, because those lower to pathologically slow-compiling
+        programs on TPU while the one-hot contraction compiles in under a
+        second and runs on the MXU.
         """
         b, r = self.row_ids.shape
         s = self.proj.shape[-1]
@@ -183,36 +207,24 @@ class BlockPlan:
 
         if isinstance(self.raw, DenseFeatures):
             d = self.raw.x.shape[1]
-            # Per-entity feature -> slot LUT on a d+1 scratch column so -1
-            # projector pads scatter harmlessly into the spill slot.
-            pr = jnp.where(proj >= 0, proj, d)
-            lut = jnp.full((b, d + 1), -1, jnp.int32)
-            lut = lut.at[
-                jnp.arange(b, dtype=jnp.int32)[:, None], pr
-            ].set(jnp.broadcast_to(iota_s, (b, s)))
-            lut = lut[:, :d]  # [B, d]
             xr = jnp.take(self.raw.x, rows, axis=0)  # [B, R, d]
-            x_indices = jnp.broadcast_to(
-                jnp.maximum(lut, 0)[:, None, :], (b, r, d)
-            )
-            x_values = jnp.where(
-                (lut >= 0)[:, None, :] & row_mask[:, :, None], xr, 0
-            )
+            # Feature->slot one-hot per entity: M[b, f, s] = proj[b,s] == f.
+            onehot = (
+                proj[:, None, :] == jnp.arange(d, dtype=proj.dtype)[None, :, None]
+            ).astype(dtype)  # [B, d, S]; -1 pads never match
+            x_values = jnp.einsum("brf,bfs->brs", xr, onehot)
+            x_values = jnp.where(row_mask[:, :, None], x_values, 0)
+            x_indices = None
         else:
             idx = jnp.take(self.raw.indices, rows, axis=0)  # [B, R, k]
             val = jnp.take(self.raw.values, rows, axis=0)
-            k = idx.shape[-1]
-            sentinel = jnp.iinfo(jnp.int32).max
-            psort = jnp.where(proj >= 0, proj, sentinel)  # stays ascending
-            flat = idx.reshape(b, r * k)
-            slot = jax.vmap(jnp.searchsorted)(psort, flat)
-            slot = jnp.minimum(slot, s - 1)
-            hit = jnp.take_along_axis(psort, slot, axis=1) == flat
-            slot = slot.reshape(b, r, k).astype(jnp.int32)
-            hit = hit.reshape(b, r, k)
-            ok = hit & (val != 0) & row_mask[:, :, None]
-            x_indices = jnp.where(ok, slot, 0)
-            x_values = jnp.where(ok, val, 0)
+            val = jnp.where(row_mask[:, :, None], val, 0)
+            # Slot one-hot: idx[b,r,k] == proj[b,s]; contraction densifies.
+            onehot = (
+                idx[:, :, :, None] == proj[:, None, None, :]
+            ).astype(dtype)  # [B, R, k, S]
+            x_values = jnp.einsum("brk,brks->brs", val, onehot)
+            x_indices = None
 
         return EntityBlocks(
             entity_codes=self.entity_codes,
@@ -903,15 +915,22 @@ def build_random_effect_dataset(
         requested_dtype is None
         or jnp.dtype(requested_dtype) == jnp.dtype(game_data.labels.dtype)
     )
+    plan = _plan_random_effect(
+        game_data, config,
+        intercept_index=intercept_index, extra_features=extra_features,
+    )
     if lazy is None:
         # An explicit score-table width cap is a signal that max_sub_dim is
         # dominated by heavy entities (SURVEY §7.3): the lazy scorer's
         # [n, S] gather intermediates would recreate exactly the hazard the
         # cap bounds, so honor it with the materialized dual-ELL table.
+        # Very wide subspaces likewise stay materialized: the lazy path's
+        # one-hot densification is sized for small sub_dims.
         lazy = (
             lazy_capable
             and dtype_matches
             and config.score_table_width_cap is None
+            and plan.max_sub_dim <= DENSE_SUB_DIM_MAX
         )
     if lazy and not lazy_capable:
         raise TypeError(
@@ -924,10 +943,6 @@ def build_random_effect_dataset(
             f"({game_data.labels.dtype} -> {requested_dtype}); pass "
             "lazy=False or build the GameDataset in the target dtype"
         )
-    plan = _plan_random_effect(
-        game_data, config,
-        intercept_index=intercept_index, extra_features=extra_features,
-    )
     tag = game_data.id_tags[config.random_effect_type]
     num_entities = tag.num_groups
 

@@ -83,6 +83,8 @@ def shard_random_effect_dataset(
     )
 
     def pad_leaf(name, leaf, pad):
+        if leaf is None:  # dense-layout EntityBlocks carry x_indices=None
+            return None
         widths = [(0, pad)] + [(0, 0)] * (np.ndim(leaf) - 1)
         return jnp.pad(leaf, widths, constant_values=fills.get(name, 0))
 

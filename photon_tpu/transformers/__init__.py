@@ -74,12 +74,17 @@ def random_effect_scorer(
     import numpy as np
 
     from photon_tpu.data.dataset import DenseFeatures, SparseFeatures
+    from photon_tpu.data.random_effect import DENSE_SUB_DIM_MAX
 
     feats = data.feature_shards[feature_shard_id]
-    # A width cap opts out of the lazy path: its [n, S] gather intermediates
-    # would recreate the width hazard the cap exists to bound.
-    if width_cap is None and isinstance(
-        feats, (DenseFeatures, SparseFeatures)
+    # A width cap — or a very wide subspace — opts out of the lazy path:
+    # its [n, S] intermediates would recreate the width hazard the cap (and
+    # the build-side DENSE_SUB_DIM_MAX gate) exist to bound.
+    sub_dim = np.asarray(proj_all).shape[1] if np.ndim(proj_all) == 2 else 0
+    if (
+        width_cap is None
+        and sub_dim <= DENSE_SUB_DIM_MAX
+        and isinstance(feats, (DenseFeatures, SparseFeatures))
     ):
         codes_np = scoring_codes(data, re_type, entity_keys).astype(np.int32)
         codes, proj_dev = jax.device_put(

@@ -1,5 +1,6 @@
 """Cross-cutting utilities: section timing + device profiling hooks."""
 
+from photon_tpu.utils.compile_cache import enable_compilation_cache
 from photon_tpu.utils.timed import Timed, profile_trace
 
-__all__ = ["Timed", "profile_trace"]
+__all__ = ["Timed", "enable_compilation_cache", "profile_trace"]
