@@ -43,6 +43,7 @@ from photon_tpu.data.dataset import (
 )
 from photon_tpu.data.random_effect import (
     DENSE_SUB_DIM_MAX,
+    ONE_HOT_ELEMENT_BUDGET,
     BlockPlan,
     EntityBlocks,
     RandomEffectDataset,
@@ -162,7 +163,12 @@ def _solve_one_entity_direct(
     if x_indices is None:
         x = x_values
     else:
-        x = _densify_ell_slots(x_indices, x_values, sub_dim)
+        # This branch only runs for wide subspaces (_solve_block densifies
+        # small ones up front): scatter-add keeps peak memory at the dense
+        # [R, S] result instead of a [R, k, S] one-hot operand.
+        r = x_values.shape[0]
+        rows = jnp.broadcast_to(jnp.arange(r)[:, None], x_indices.shape)
+        x = jnp.zeros((r, sub_dim), dtype).at[rows, x_indices].add(x_values)
     if shifts is not None:
         x = x - shifts[None, :]
     if factors is not None:
@@ -360,10 +366,16 @@ def _solve_block(
                 0.0,
             )
     dtype = block.x_values.dtype
-    if block.x_indices is not None and sub_dim <= DENSE_SUB_DIM_MAX:
+    if (
+        block.x_indices is not None
+        and sub_dim <= DENSE_SUB_DIM_MAX
+        and int(np.prod(block.x_indices.shape)) * sub_dim
+        <= ONE_HOT_ELEMENT_BUDGET
+    ):
         # Densify small-subspace ELL blocks so every downstream op is a
         # matmul; batched gather/scatter both execute worse and compile
-        # ~40x slower on TPU.
+        # ~40x slower on TPU. The element budget keeps the transient
+        # one-hot operand bounded; over-budget blocks stay ELL.
         block = dataclasses.replace(
             block,
             x_indices=None,

@@ -192,6 +192,10 @@ class TrainingConfig:
     # cluster-session default, SparkSessionConfiguration.scala:109), "off",
     # or a device count.
     mesh: str | int = "auto"
+    # Per-feature summary artifact directory (GameTrainingDriver
+    # dataSummaryDirectory): when set, each shard's stats are written as
+    # FeatureSummarizationResultAvro under <dir>/<shardId>/.
+    data_summary_dir: str | None = None
 
     @staticmethod
     def load(path: str) -> "TrainingConfig":
@@ -230,6 +234,7 @@ class TrainingConfig:
             date_range=raw.get("input", {}).get("date_range"),
             days_range=raw.get("input", {}).get("days_range"),
             mesh=raw.get("mesh", "auto"),
+            data_summary_dir=raw.get("data_summary_dir"),
         )
 
     def opt_config_sequence(self) -> list[dict[str, GLMOptimizationConfiguration]]:

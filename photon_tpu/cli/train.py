@@ -260,25 +260,41 @@ def _run(args) -> int:
     # feature stats + normalization (prepareNormalizationContexts :590)
     # ------------------------------------------------------------------
     norm_contexts = {}
-    if cfg.normalization != NormalizationType.NONE:
-        import numpy as np
+    if (
+        cfg.normalization != NormalizationType.NONE
+        or cfg.data_summary_dir
+    ):
+        import jax.numpy as jnp
+
+        from photon_tpu.cli.common import is_coordinator
 
         for s in shards:
             stats = FeatureDataStatistics.from_features(
                 train.feature_shards[s],
-                np.asarray(train.weights),
+                train.host_column("weights"),
                 intercept_index=intercept_indices.get(s),
             )
-            import jax.numpy as jnp
+            if cfg.data_summary_dir and is_coordinator():
+                # calculateAndSaveFeatureShardStats :616-627: one
+                # FeatureSummarizationResultAvro dir per shard.
+                from photon_tpu.io.model_io import save_feature_stats
 
-            norm_contexts[s] = build_normalization_context(
-                cfg.normalization,
-                mean=jnp.asarray(stats.mean),
-                variance=jnp.asarray(stats.variance),
-                min_=jnp.asarray(stats.min),
-                max_=jnp.asarray(stats.max),
-                intercept_index=intercept_indices.get(s),
-            )
+                save_feature_stats(
+                    os.path.join(cfg.data_summary_dir, s),
+                    stats,
+                    index_maps[s],
+                )
+                log.info("feature stats for shard %r written to %s",
+                         s, os.path.join(cfg.data_summary_dir, s))
+            if cfg.normalization != NormalizationType.NONE:
+                norm_contexts[s] = build_normalization_context(
+                    cfg.normalization,
+                    mean=jnp.asarray(stats.mean),
+                    variance=jnp.asarray(stats.variance),
+                    min_=jnp.asarray(stats.min),
+                    max_=jnp.asarray(stats.max),
+                    intercept_index=intercept_indices.get(s),
+                )
 
     # ------------------------------------------------------------------
     # fit over the lambda grid (GameEstimator.fit :397)

@@ -137,6 +137,10 @@ class EntityBlocks:
 # fastest-compiling and the most MXU-friendly layout. Above it, the one-hot
 # tensors get large and blocks stay in ELL form.
 DENSE_SUB_DIM_MAX = 128
+# Element budget for materialized one-hot operands (the dot_general operand
+# is NOT fused away): beyond this, fall back to gather/scatter lowerings,
+# which compile slowly but keep memory at the ELL slab's order.
+ONE_HOT_ELEMENT_BUDGET = 1 << 28
 
 
 @jax.tree_util.register_dataclass
@@ -175,12 +179,14 @@ class BlockPlan:
 
         Returns an ``EntityBlocks`` whose ``offsets`` already include the
         coordinate-descent residuals. For sub_dims up to
-        ``DENSE_SUB_DIM_MAX`` the feature slab comes out subspace-DENSE,
-        built by one-hot einsums (comparisons feeding a matmul) — row
-        gathers are plain ``jnp.take``; there is no batched gather/scatter
-        anywhere, because those lower to pathologically slow-compiling
-        programs on TPU while the one-hot contraction compiles in under a
-        second and runs on the MXU.
+        ``DENSE_SUB_DIM_MAX`` (within the one-hot element budget) the
+        feature slab comes out subspace-DENSE, built by one-hot einsums
+        (comparisons feeding a matmul) — row gathers are plain
+        ``jnp.take``; no batched gather/scatter, because those lower to
+        pathologically slow-compiling programs on TPU while the one-hot
+        contraction compiles in under a second and runs on the MXU. Wider
+        subspaces (or over-budget one-hot operands) fall back to ELL form
+        via gather lowerings: slower compiles, bounded memory.
         """
         b, r = self.row_ids.shape
         s = self.proj.shape[-1]
@@ -208,23 +214,60 @@ class BlockPlan:
         if isinstance(self.raw, DenseFeatures):
             d = self.raw.x.shape[1]
             xr = jnp.take(self.raw.x, rows, axis=0)  # [B, R, d]
-            # Feature->slot one-hot per entity: M[b, f, s] = proj[b,s] == f.
-            onehot = (
-                proj[:, None, :] == jnp.arange(d, dtype=proj.dtype)[None, :, None]
-            ).astype(dtype)  # [B, d, S]; -1 pads never match
-            x_values = jnp.einsum("brf,bfs->brs", xr, onehot)
-            x_values = jnp.where(row_mask[:, :, None], x_values, 0)
-            x_indices = None
+            if s <= DENSE_SUB_DIM_MAX and b * d * s <= ONE_HOT_ELEMENT_BUDGET:
+                # Feature->slot one-hot per entity:
+                # M[b, f, s] = proj[b,s] == f; -1 pads never match.
+                onehot = (
+                    proj[:, None, :]
+                    == jnp.arange(d, dtype=proj.dtype)[None, :, None]
+                ).astype(dtype)  # [B, d, S]
+                x_values = jnp.einsum("brf,bfs->brs", xr, onehot)
+                x_values = jnp.where(row_mask[:, :, None], x_values, 0)
+                x_indices = None
+            else:
+                # Guarded fallback: LUT gather keeps memory at O(B d + B R d)
+                # at the cost of a slow-compiling batched scatter/gather.
+                pr = jnp.where(proj >= 0, proj, d)
+                lut = jnp.full((b, d + 1), -1, jnp.int32)
+                lut = lut.at[
+                    jnp.arange(b, dtype=jnp.int32)[:, None], pr
+                ].set(jnp.broadcast_to(iota_s, (b, s)))
+                lut = lut[:, :d]  # [B, d]
+                x_indices = jnp.broadcast_to(
+                    jnp.maximum(lut, 0)[:, None, :], (b, r, d)
+                )
+                x_values = jnp.where(
+                    (lut >= 0)[:, None, :] & row_mask[:, :, None], xr, 0
+                )
         else:
             idx = jnp.take(self.raw.indices, rows, axis=0)  # [B, R, k]
             val = jnp.take(self.raw.values, rows, axis=0)
             val = jnp.where(row_mask[:, :, None], val, 0)
-            # Slot one-hot: idx[b,r,k] == proj[b,s]; contraction densifies.
-            onehot = (
-                idx[:, :, :, None] == proj[:, None, None, :]
-            ).astype(dtype)  # [B, R, k, S]
-            x_values = jnp.einsum("brk,brks->brs", val, onehot)
-            x_indices = None
+            k = idx.shape[-1]
+            if (
+                s <= DENSE_SUB_DIM_MAX
+                and b * r * k * s <= ONE_HOT_ELEMENT_BUDGET
+            ):
+                # Slot one-hot: idx[b,r,k] == proj[b,s]; the contraction
+                # densifies without any gather/scatter.
+                onehot = (
+                    idx[:, :, :, None] == proj[:, None, None, :]
+                ).astype(dtype)  # [B, R, k, S]
+                x_values = jnp.einsum("brk,brks->brs", val, onehot)
+                x_indices = None
+            else:
+                # Guarded fallback: binary-search remap keeps ELL form
+                # (O(B R k) memory, slow-compiling batched gathers).
+                sentinel = jnp.iinfo(jnp.int32).max
+                psort = jnp.where(proj >= 0, proj, sentinel)  # ascending
+                flat = idx.reshape(b, r * k)
+                slot = jax.vmap(jnp.searchsorted)(psort, flat)
+                slot = jnp.minimum(slot, s - 1)
+                hit = jnp.take_along_axis(psort, slot, axis=1) == flat
+                slot = slot.reshape(b, r, k).astype(jnp.int32)
+                ok = hit.reshape(b, r, k) & (val != 0)
+                x_indices = jnp.where(ok, slot, 0)
+                x_values = jnp.where(ok, val, 0)
 
         return EntityBlocks(
             entity_codes=self.entity_codes,

@@ -41,7 +41,13 @@ _POS = 0.5  # MathConst.POSITIVE_RESPONSE_THRESHOLD
 
 
 class EvaluatorType(enum.Enum):
-    """Names match EvaluatorType.scala so configs/CLIs stay compatible."""
+    """Names match EvaluatorType.scala so configs/CLIs stay compatible.
+
+    MAE / MSE / PEAK_F1 come from the legacy driver's metric family
+    (photon-client evaluation/Evaluation.scala:33-41: "Mean absolute
+    error", "Mean square error", "Peak F1 score"), which the GAME
+    EvaluatorType enum never absorbed upstream.
+    """
 
     AUC = "AUC"
     AUPR = "AUPR"
@@ -50,14 +56,24 @@ class EvaluatorType(enum.Enum):
     POISSON_LOSS = "POISSON_LOSS"
     SMOOTHED_HINGE_LOSS = "SMOOTHED_HINGE_LOSS"
     SQUARED_LOSS = "SQUARED_LOSS"
+    MAE = "MAE"
+    MSE = "MSE"
+    PEAK_F1 = "PEAK_F1"
 
     @property
     def bigger_is_better(self) -> bool:
         """The model-selection comparator direction (EvaluatorType.op)."""
-        return self in (EvaluatorType.AUC, EvaluatorType.AUPR)
+        return self in (
+            EvaluatorType.AUC, EvaluatorType.AUPR, EvaluatorType.PEAK_F1
+        )
 
     def better_than(self, a: float, b: float) -> bool:
         return a > b if self.bigger_is_better else a < b
+
+
+# Threshold-based binary metric names (legacy driver Evaluation.scala:196
+# metric map: precision/recall/F1/accuracy at a score threshold).
+THRESHOLD_METRICS = ("PRECISION", "RECALL", "F1", "ACCURACY")
 
 
 # --------------------------------------------------------------------------
@@ -129,6 +145,85 @@ def smoothed_hinge_loss(scores, labels, weights=None) -> Array:
     return _weighted_loss_sum(losses_mod.SMOOTHED_HINGE, scores, labels, weights)
 
 
+def mae(scores, labels, weights=None) -> Array:
+    """Weighted mean absolute error (Evaluation.scala MEAN_ABSOLUTE_ERROR;
+    Spark RegressionMetrics.meanAbsoluteError at unit weights)."""
+    w = jnp.ones_like(scores) if weights is None else weights
+    return jnp.sum(w * jnp.abs(scores - labels)) / jnp.sum(w)
+
+
+def mse(scores, labels, weights=None) -> Array:
+    """Weighted mean squared error (Evaluation.scala MEAN_SQUARE_ERROR)."""
+    w = jnp.ones_like(scores) if weights is None else weights
+    d = scores - labels
+    return jnp.sum(w * d * d) / jnp.sum(w)
+
+
+def _confusion_weights(scores, labels, threshold, weights):
+    """Weighted (tp, fp, fn, tn) at a mean-space threshold.
+
+    ``threshold`` lives in probability space (the reference thresholds the
+    model MEAN, Evaluation.scala computeMeanFunctionWithOffset); scores are
+    margins, so the cut is margin >= logit(threshold).
+    """
+    t = jnp.log(threshold) - jnp.log1p(-threshold)  # logit
+    w = jnp.ones_like(scores) if weights is None else weights
+    pred = scores >= t
+    pos = labels > _POS
+    tp = jnp.sum(jnp.where(pred & pos, w, 0.0))
+    fp = jnp.sum(jnp.where(pred & ~pos, w, 0.0))
+    fn = jnp.sum(jnp.where(~pred & pos, w, 0.0))
+    tn = jnp.sum(jnp.where(~pred & ~pos, w, 0.0))
+    return tp, fp, fn, tn
+
+
+def precision_at_threshold(scores, labels, threshold, weights=None) -> Array:
+    tp, fp, _, _ = _confusion_weights(scores, labels, threshold, weights)
+    return jnp.where(tp + fp > 0, tp / jnp.maximum(tp + fp, 1e-300), 0.0)
+
+
+def recall_at_threshold(scores, labels, threshold, weights=None) -> Array:
+    tp, _, fn, _ = _confusion_weights(scores, labels, threshold, weights)
+    return jnp.where(tp + fn > 0, tp / jnp.maximum(tp + fn, 1e-300), 0.0)
+
+
+def f1_at_threshold(scores, labels, threshold, weights=None) -> Array:
+    tp, fp, fn, _ = _confusion_weights(scores, labels, threshold, weights)
+    denom = 2.0 * tp + fp + fn
+    return jnp.where(denom > 0, 2.0 * tp / jnp.maximum(denom, 1e-300), 0.0)
+
+
+def accuracy_at_threshold(scores, labels, threshold, weights=None) -> Array:
+    tp, fp, fn, tn = _confusion_weights(scores, labels, threshold, weights)
+    total = tp + fp + fn + tn
+    return jnp.where(total > 0, (tp + tn) / jnp.maximum(total, 1e-300), 0.0)
+
+
+def peak_f1(scores, labels, weights=None) -> Array:
+    """Max F1 over all score thresholds, tie-aware.
+
+    Reference: Evaluation.scala PEAK_F1_SCORE =
+    ``binaryMetrics.fMeasureByThreshold().map(_._2).max`` — the F1 sweep
+    over every distinct score treated as a cut. Sorted descending, with
+    cumulative true positives tp_i and predicted-positive mass p_i, F1 at a
+    cut equals 2*tp / (p + pos_total); only tie-block ends are valid cuts.
+    """
+    w = jnp.ones_like(scores) if weights is None else weights
+    order = jnp.argsort(-scores)
+    s = scores[order]
+    pos_w = jnp.where(labels[order] > _POS, w[order], 0.0)
+    w_sorted = w[order]
+    tp = jnp.cumsum(pos_w)
+    pred = jnp.cumsum(w_sorted)
+    pos_total = tp[-1]
+    f1 = 2.0 * tp / jnp.maximum(pred + pos_total, 1e-300)
+    # A position is a valid cut only if the next score differs (tie block end).
+    block_end = jnp.concatenate(
+        [s[:-1] != s[1:], jnp.ones(1, dtype=bool)]
+    )
+    return jnp.max(jnp.where(block_end, f1, -jnp.inf))
+
+
 def rmse(scores, labels, weights=None) -> Array:
     """sqrt(sum(w * (s-y)^2) / n) (RMSEEvaluator.scala: squared loss over
     the unweighted count)."""
@@ -144,7 +239,23 @@ _SINGLE = {
     EvaluatorType.POISSON_LOSS: poisson_loss,
     EvaluatorType.SMOOTHED_HINGE_LOSS: smoothed_hinge_loss,
     EvaluatorType.SQUARED_LOSS: squared_loss,
+    EvaluatorType.MAE: mae,
+    EvaluatorType.MSE: mse,
+    EvaluatorType.PEAK_F1: peak_f1,
 }
+
+_THRESHOLD = {
+    "PRECISION": precision_at_threshold,
+    "RECALL": recall_at_threshold,
+    "F1": f1_at_threshold,
+    "ACCURACY": accuracy_at_threshold,
+}
+
+
+def evaluate_at_threshold(
+    metric: str, scores, labels, threshold: float, weights=None
+) -> Array:
+    return _THRESHOLD[metric](scores, labels, threshold, weights)
 
 
 def evaluate_single(
@@ -271,9 +382,16 @@ class EvaluatorSpec:
     evaluator_type: EvaluatorType | None = None
     group_tag: str | None = None
     precision_k: int | None = None
+    # Threshold-based binary metric: one of THRESHOLD_METRICS at a
+    # mean-space score threshold (legacy driver Evaluation.scala:196).
+    # Spec syntax: "PRECISION=0.5", "F1=0.25", ...
+    threshold_metric: str | None = None
+    threshold: float | None = None
 
     @property
     def name(self) -> str:
+        if self.threshold_metric is not None:
+            return f"{self.threshold_metric}={self.threshold:g}"
         if self.precision_k is not None:
             return f"PRECISION@{self.precision_k}:{self.group_tag}"
         assert self.evaluator_type is not None
@@ -283,7 +401,7 @@ class EvaluatorSpec:
 
     @property
     def bigger_is_better(self) -> bool:
-        if self.precision_k is not None:
+        if self.precision_k is not None or self.threshold_metric is not None:
             return True
         assert self.evaluator_type is not None
         return self.evaluator_type.bigger_is_better
@@ -294,6 +412,23 @@ class EvaluatorSpec:
     @staticmethod
     def parse(spec: str) -> "EvaluatorSpec":
         spec = spec.strip()
+        if "=" in spec:
+            head, t = spec.split("=", 1)
+            head = head.strip().upper()
+            if head not in THRESHOLD_METRICS:
+                raise ValueError(
+                    f"unknown threshold metric {head!r}; expected one of "
+                    f"{THRESHOLD_METRICS}"
+                )
+            threshold = float(t)
+            if not 0.0 < threshold < 1.0:
+                raise ValueError(
+                    f"threshold metric cut must be in (0, 1) — it applies "
+                    f"to the model mean — got {threshold}"
+                )
+            return EvaluatorSpec(
+                threshold_metric=head, threshold=threshold
+            )
         if ":" in spec:
             head, tag = spec.split(":", 1)
             if head.upper().startswith("PRECISION@"):

@@ -17,6 +17,7 @@ import numpy as np
 
 from photon_tpu.evaluation.evaluators import (
     EvaluatorSpec,
+    evaluate_at_threshold,
     evaluate_single,
     grouped_auc,
     grouped_auc_per_group,
@@ -74,6 +75,11 @@ class EvaluationSuite:
         z = scores + self.offsets
         out: dict[str, float] = {}
         for spec in self.specs:
+            if spec.threshold_metric is not None:
+                out[spec.name] = float(evaluate_at_threshold(
+                    spec.threshold_metric, z, self.labels, spec.threshold,
+                    self.weights))
+                continue
             if spec.group_tag is not None:
                 codes, num_groups = self.group_ids[spec.group_tag]
                 if spec.precision_k is not None:

@@ -399,6 +399,77 @@ def save_scores(
     )
 
 
+FEATURE_SUMMARIZATION_SCHEMA = {
+    "name": "FeatureSummarizationResultAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "featureName", "type": "string"},
+        {"name": "featureTerm", "type": "string"},
+        {"name": "metrics", "type": {"type": "map", "values": "double"}},
+    ],
+}
+
+
+def save_feature_stats(path: str, stats, index_map: IndexMap) -> None:
+    """Per-feature summary artifact (one record per non-intercept feature).
+
+    Reference: ModelProcessingUtils.writeBasicStatistics (photon-client
+    data/avro/ModelProcessingUtils.scala:514-560) — the metrics map carries
+    max/min/mean/normL1/normL2/numNonzeros/variance per (name, term), with
+    the intercept filtered out; written under
+    ``<dataSummaryDirectory>/<shardId>`` by the training driver
+    (GameTrainingDriver.calculateAndSaveFeatureShardStats :616-627).
+    """
+    from photon_tpu.types import split_feature_key
+
+    os.makedirs(path, exist_ok=True)
+    skip = stats.intercept_index
+    zeros = np.zeros(stats.dim)
+    l1 = zeros if stats.norm_l1 is None else stats.norm_l1
+    l2 = zeros if stats.norm_l2 is None else stats.norm_l2
+
+    def records():
+        for idx in range(stats.dim):
+            if idx == skip:
+                continue
+            key = index_map.get_feature_name(idx)
+            if key is None:
+                continue
+            name, term = split_feature_key(key)
+            yield {
+                "featureName": name,
+                "featureTerm": term,
+                "metrics": {
+                    "max": float(stats.max[idx]),
+                    "min": float(stats.min[idx]),
+                    "mean": float(stats.mean[idx]),
+                    "normL1": float(l1[idx]),
+                    "normL2": float(l2[idx]),
+                    "numNonzeros": float(stats.num_nonzeros[idx]),
+                    "variance": float(stats.variance[idx]),
+                },
+            }
+
+    avro.write_container(
+        os.path.join(path, "part-00000.avro"),
+        FEATURE_SUMMARIZATION_SCHEMA,
+        records(),
+    )
+
+
+def load_feature_stats(path: str) -> dict[str, dict[str, float]]:
+    """Read a stats artifact back: feature key -> metrics map."""
+    from photon_tpu.types import make_feature_key
+
+    out: dict[str, dict[str, float]] = {}
+    for rec in avro.read_container_dir(path):
+        out[make_feature_key(rec["featureName"], rec["featureTerm"])] = {
+            k: float(v) for k, v in rec["metrics"].items()
+        }
+    return out
+
+
 # --------------------------------------------------------------------------
 # native checkpoint (fast path; no Avro name-keying)
 # --------------------------------------------------------------------------

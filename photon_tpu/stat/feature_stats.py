@@ -40,6 +40,11 @@ class FeatureDataStatistics:
     num_nonzeros: np.ndarray  # [d] weighted nnz count
     count: float  # total weight
     intercept_index: int | None = None
+    # Weighted norms (Spark summarizer normL1 = sum w|x|, normL2 =
+    # sqrt(sum w x^2)) — consumed by the feature-stats output artifact
+    # (ModelProcessingUtils.writeBasicStatistics metrics map).
+    norm_l1: np.ndarray | None = None  # [d]
+    norm_l2: np.ndarray | None = None  # [d]
 
     @property
     def dim(self) -> int:
@@ -60,6 +65,7 @@ class FeatureDataStatistics:
             sum_w = float(w.sum())
             mean = (w @ x) / sum_w
             ex2 = (w @ (x * x)) / sum_w
+            norm_l1 = w @ np.abs(x)
             # Spark's MultivariateOnlineSummarizer skips non-positive-weight
             # rows entirely; keep min/max parity by masking them out.
             xw = x[w > 0.0]
@@ -110,9 +116,11 @@ class FeatureDataStatistics:
             s1 = np.zeros(d)
             s2 = np.zeros(d)
             nnz = np.zeros(d)
+            norm_l1 = np.zeros(d)
             np.add.at(s1, flat_idx, flat_w * flat_val)
             np.add.at(s2, flat_idx, flat_w * flat_val * flat_val)
             np.add.at(nnz, flat_idx, flat_w)
+            np.add.at(norm_l1, flat_idx, flat_w * np.abs(flat_val))
             mean = s1 / sum_w
             ex2 = s2 / sum_w
             # min/max over stored values; implicit zeros count whenever a
@@ -139,4 +147,6 @@ class FeatureDataStatistics:
             num_nonzeros=nnz,
             count=sum_w,
             intercept_index=intercept_index,
+            norm_l1=norm_l1,
+            norm_l2=np.sqrt(np.maximum(ex2 * sum_w, 0.0)),
         )

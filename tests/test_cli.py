@@ -735,3 +735,45 @@ def test_maybe_init_distributed_single_host_noop():
     assert maybe_init_distributed() is False
     assert maybe_init_distributed() is False  # idempotent
     assert is_coordinator() is True
+
+
+def test_feature_stats_artifact(tmp_path, glmix_avro, capsys):
+    """data_summary_dir writes per-shard FeatureSummarizationResultAvro
+    files (ModelProcessingUtils.writeBasicStatistics layout) that round-trip
+    and match a direct numpy computation; the intercept is excluded."""
+    from photon_tpu.cli.train import main
+    from photon_tpu.io.model_io import load_feature_stats
+    from photon_tpu.types import make_feature_key
+
+    train, val = glmix_avro
+    summary_dir = tmp_path / "summary"
+    cfg_path, _ = _config(
+        tmp_path, train, val, data_summary_dir=str(summary_dir),
+        evaluators=["RMSE", "MAE", "MSE"],
+    )
+    assert main(["--config", str(cfg_path)]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "MAE" in out["evaluation"] and "MSE" in out["evaluation"]
+
+    stats = load_feature_stats(str(summary_dir / "features"))
+    # 5 named features; the intercept record is filtered out.
+    assert len(stats) == 5
+    key = make_feature_key("f0", "t")
+    m = stats[key]
+    assert set(m) == {
+        "max", "min", "mean", "normL1", "normL2", "numNonzeros", "variance"}
+    # Cross-check against the raw written data.
+    from photon_tpu.io.avro import read_container
+
+    _, recs = read_container(str(train))
+    vals = np.array([
+        f["value"] for r in recs for f in r["features"]
+        if f["name"] == "f0" and f["term"] == "t"
+    ])
+    np.testing.assert_allclose(m["mean"], vals.mean(), rtol=1e-6)
+    np.testing.assert_allclose(m["max"], vals.max(), rtol=1e-6)
+    np.testing.assert_allclose(m["normL1"], np.abs(vals).sum(), rtol=1e-6)
+    np.testing.assert_allclose(
+        m["normL2"], np.sqrt((vals ** 2).sum()), rtol=1e-6)
+    np.testing.assert_allclose(
+        m["variance"], vals.var(ddof=1), rtol=1e-5)

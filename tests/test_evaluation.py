@@ -141,3 +141,82 @@ def test_suite_end_to_end(rng):
 def test_suite_rejects_missing_tag(rng):
     with pytest.raises(ValueError):
         make_suite(["AUC:queryId"], np.zeros(5))
+
+
+# --------------------------------------------------------------------------
+# Legacy-driver metric family (Evaluation.scala:31-110): threshold metrics,
+# peak F1, MAE/MSE.
+# --------------------------------------------------------------------------
+
+
+def test_threshold_metrics_vs_sklearn(scored):
+    """PRECISION/RECALL/F1/ACCURACY at a mean-space threshold t equal
+    sklearn's metrics with predictions sigmoid(margin) >= t."""
+    scores, labels = scored
+    s = np.asarray(scores)
+    y = np.asarray(labels)
+    for t in (0.3, 0.5, 0.7):
+        pred = 1.0 / (1.0 + np.exp(-s)) >= t
+        got = {
+            "PRECISION": float(ev.precision_at_threshold(scores, labels, t)),
+            "RECALL": float(ev.recall_at_threshold(scores, labels, t)),
+            "F1": float(ev.f1_at_threshold(scores, labels, t)),
+            "ACCURACY": float(ev.accuracy_at_threshold(scores, labels, t)),
+        }
+        np.testing.assert_allclose(
+            got["PRECISION"],
+            skm.precision_score(y, pred, zero_division=0), rtol=1e-6)
+        np.testing.assert_allclose(
+            got["RECALL"], skm.recall_score(y, pred), rtol=1e-6)
+        np.testing.assert_allclose(
+            got["F1"], skm.f1_score(y, pred), rtol=1e-6)
+        np.testing.assert_allclose(
+            got["ACCURACY"], skm.accuracy_score(y, pred), rtol=1e-6)
+
+
+def test_peak_f1_vs_sklearn_sweep(scored):
+    """PEAK_F1 == max F1 over the precision-recall threshold sweep
+    (Evaluation.scala PEAK_F1_SCORE = fMeasureByThreshold().max)."""
+    scores, labels = scored
+    y = np.asarray(labels)
+    s = np.asarray(scores)
+    prec, rec, _ = skm.precision_recall_curve(y, s)
+    f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-300)
+    np.testing.assert_allclose(
+        float(ev.peak_f1(scores, labels)), f1.max(), rtol=1e-6)
+
+
+def test_mae_mse(rng):
+    y = rng.normal(size=100)
+    s = y + rng.normal(size=100)
+    np.testing.assert_allclose(
+        float(ev.mae(jnp.asarray(s), jnp.asarray(y))),
+        np.abs(s - y).mean(), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(ev.mse(jnp.asarray(s), jnp.asarray(y))),
+        ((s - y) ** 2).mean(), rtol=1e-6)
+
+
+def test_threshold_spec_parse_and_suite(rng):
+    spec = ev.EvaluatorSpec.parse("F1=0.25")
+    assert spec.threshold_metric == "F1" and spec.threshold == 0.25
+    assert spec.name == "F1=0.25" and spec.bigger_is_better
+    with pytest.raises(ValueError):
+        ev.EvaluatorSpec.parse("F1=1.5")  # threshold must be in (0, 1)
+    with pytest.raises(ValueError):
+        ev.EvaluatorSpec.parse("BOGUS=0.5")
+    assert ev.EvaluatorSpec.parse("peak_f1").evaluator_type == (
+        ev.EvaluatorType.PEAK_F1)
+
+    n = 150
+    y = (rng.uniform(size=n) > 0.5).astype(float)
+    scores = jnp.asarray(y + rng.normal(size=n))
+    suite = make_suite(
+        ["AUC", "PRECISION=0.5", "ACCURACY=0.4", "PEAK_F1", "MAE"], y)
+    res = suite.evaluate(scores)
+    assert set(res.evaluations) == {
+        "AUC", "PRECISION=0.5", "ACCURACY=0.4", "PEAK_F1", "MAE"}
+    pred = 1.0 / (1.0 + np.exp(-np.asarray(scores))) >= 0.5
+    np.testing.assert_allclose(
+        res.evaluations["PRECISION=0.5"],
+        skm.precision_score(y, pred, zero_division=0), rtol=1e-6)
