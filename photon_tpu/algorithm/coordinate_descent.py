@@ -81,7 +81,12 @@ class CoordinateDescent:
         num_iterations: int,
         *,
         locked_coordinates: set[str] | None = None,
+        emitter=None,
     ):
+        # Optional event fan-out (photon_tpu.events.EventEmitter): a
+        # CoordinateUpdateEvent after every coordinate update
+        # (EventEmitter.scala:24 semantics, wired to the GAME path).
+        self.emitter = emitter
         if num_iterations < 1:
             raise ValueError(f"num_iterations must be >= 1: {num_iterations}")
         seen = set()
@@ -223,6 +228,16 @@ class CoordinateDescent:
                     diagnostics=diag,
                     evaluation=evaluation,
                 ))
+                if self.emitter is not None:
+                    from photon_tpu.events import CoordinateUpdateEvent
+
+                    self.emitter.send_event(CoordinateUpdateEvent(
+                        iteration=it,
+                        coordinate_id=cid,
+                        seconds=seconds,
+                        diagnostics=diag,
+                        evaluation=evaluation,
+                    ))
 
         final = GameModel(dict(models))
         if best_model is None:

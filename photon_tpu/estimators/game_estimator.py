@@ -148,6 +148,7 @@ class GameEstimator:
         locked_coordinates: set[str] | None = None,
         incremental_training: bool = False,
         mesh="auto",
+        listeners=None,
     ):
         self.task = task
         self.coordinate_configs = dict(coordinate_configs)
@@ -176,6 +177,14 @@ class GameEstimator:
         # Pass "off"/None for single-device, or a jax.sharding.Mesh / device
         # count to control placement explicitly.
         self.mesh = mesh
+        # Training-event fan-out (events.EventEmitter listener registry):
+        # CoordinateUpdateEvent per coordinate update, FitEndEvent per
+        # optimization config (EventEmitter.scala:24 for the GAME path).
+        self.emitter = None
+        if listeners:
+            from photon_tpu.events import EventEmitter
+
+            self.emitter = EventEmitter(listeners)
 
     def resolve_mesh(self):
         """mesh param -> Mesh | None (resolved once; devices don't change)."""
@@ -432,6 +441,7 @@ class GameEstimator:
                 self.update_sequence,
                 self.num_iterations,
                 locked_coordinates=self.locked_coordinates,
+                emitter=self.emitter,
             )
             initial_models = {}
             if prev_model is not None:
@@ -467,12 +477,19 @@ class GameEstimator:
                 cid: opt_configs.get(cid, self.coordinate_configs[cid].optimization)
                 for cid in self.update_sequence
             }
-            results.append(GameFitResult(
+            result = GameFitResult(
                 model=descent.best_model,
                 config=full_config,
                 evaluation=descent.best_evaluation,
                 descent=descent,
-            ))
+            )
+            results.append(result)
+            if self.emitter is not None:
+                from photon_tpu.events import FitEndEvent
+
+                self.emitter.send_event(
+                    FitEndEvent(config_index=i, result=result)
+                )
             prev_model = descent.model
         return results
 

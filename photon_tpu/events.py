@@ -1,0 +1,68 @@
+"""Event system: typed training events + a listener registry.
+
+TPU-native counterpart of the reference's event bus (photon-client
+event/EventEmitter.scala:24 — a trait holding a listener list with
+``sendEvent`` fan-out — and the ``Event`` case classes in
+event/Event.scala:65). Upstream only the legacy driver wires it; here the
+GAME path emits directly from ``CoordinateDescent`` and ``GameEstimator``,
+so callers can observe training progress (per-coordinate diagnostics,
+per-config results) without polling or log scraping.
+
+Listeners are plain callables ``listener(event) -> None``; exceptions
+propagate (a listener that raises aborts training, matching the reference's
+synchronous ``foreach`` fan-out).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class PhotonEvent:
+    """Base event type (event/Event.scala:65)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateUpdateEvent(PhotonEvent):
+    """One coordinate update finished (the per-iteration log record of
+    CoordinateDescent.descend, CoordinateDescent.scala:322-333)."""
+
+    iteration: int
+    coordinate_id: str
+    seconds: float
+    diagnostics: Any
+    evaluation: Any  # EvaluationResults | None
+
+
+@dataclasses.dataclass(frozen=True)
+class FitEndEvent(PhotonEvent):
+    """One optimization configuration's coordinate-descent run finished
+    (the per-config result of GameEstimator.fit :458)."""
+
+    config_index: int
+    result: Any  # GameFitResult
+
+
+Listener = Callable[[PhotonEvent], None]
+
+
+class EventEmitter:
+    """Listener registry with synchronous fan-out (EventEmitter.scala:24)."""
+
+    def __init__(self, listeners=None):
+        self._listeners: list[Listener] = list(listeners or ())
+
+    def add_listener(self, listener: Listener) -> None:
+        self._listeners.append(listener)
+
+    def remove_listener(self, listener: Listener) -> None:
+        self._listeners.remove(listener)
+
+    def clear_listeners(self) -> None:
+        self._listeners.clear()
+
+    def send_event(self, event: PhotonEvent) -> None:
+        for listener in self._listeners:
+            listener(event)

@@ -20,6 +20,7 @@ from photon_tpu.optim.base import (
     ValueAndGrad,
 )
 from photon_tpu.optim.lbfgs import lbfgs_solve
+from photon_tpu.optim.lbfgsb import lbfgsb_solve
 from photon_tpu.optim.owlqn import owlqn_solve
 from photon_tpu.optim.regularization import (
     RegularizationContext,
@@ -47,6 +48,7 @@ __all__ = [
     "Tolerances",
     "ValueAndGrad",
     "lbfgs_solve",
+    "lbfgsb_solve",
     "owlqn_solve",
     "solve",
     "tron_solve",

@@ -11,8 +11,9 @@ solves share one fused kernel.
 
 Shapes are static: the (s, y) history lives in fixed ``[m, d]`` ring buffers
 (``num_corrections`` = m, default 10 like LBFGS.scala:150), and the line
-search is a bounded backtracking-Armijo loop. Box constraints are applied by
-projection after each accepted step (LBFGS.scala:56-79 semantics).
+search is a bounded strong-Wolfe bracketing/zoom loop (Breeze's
+StrongWolfeLineSearch counterpart). Box-constrained configs route to the
+bound-constrained solver in ``lbfgsb.py`` (LBFGSB.scala semantics).
 """
 
 from __future__ import annotations
@@ -32,7 +33,6 @@ from photon_tpu.optim.base import (
     _l2norm,
     absolute_tolerances,
     convergence_code,
-    project_box,
 )
 
 Array = jax.Array
@@ -110,39 +110,99 @@ def _push_history(hist: _History, s: Array, y: Array) -> _History:
     )
 
 
-class _LSResult(NamedTuple):
+# Strong-Wolfe curvature constant (Breeze StrongWolfeLineSearch: c1 = 1e-4,
+# c2 = 0.9 — the standard L-BFGS pairing; Armijo-only backtracking accepts
+# steps with poor curvature on ill-conditioned problems and the history
+# degrades toward steepest descent).
+_C2 = 0.9
+
+
+class _WolfeState(NamedTuple):
     t: Array
-    f_new: Array
-    improved: Array
+    f_t: Array
+    g_t: Array  # full gradient at w + t d (reused by the caller)
+    t_lo: Array
+    f_lo: Array
+    t_hi: Array
+    bracketed: Array
+    it: Array
+    done: Array
 
 
-def _armijo_line_search(
-    fun: ValueAndGrad, w: Array, f: Array, d: Array, dderiv: Array, t0: Array,
-    max_iters: int,
-) -> _LSResult:
-    """Backtracking line search on f(w + t d) with the Armijo condition.
+def _wolfe_line_search(
+    fun: ValueAndGrad, w: Array, f0: Array, g0: Array, d: Array,
+    dderiv: Array, t0: Array, max_iters: int,
+):
+    """Strong-Wolfe line search (Nocedal-Wright 3.5/3.6, bisection zoom).
 
-    ``dderiv`` is the directional derivative used in the sufficient-decrease
-    test (g.d for L-BFGS; the pseudo-gradient version for OWL-QN overrides
-    the evaluation function instead).
+    Returns (t, f_t, g_t, ok): ``ok`` certifies the Armijo condition; the
+    curvature condition holds on all but pathological exits. One
+    value-and-grad evaluation per probe; the accepted gradient is returned
+    so the caller pays no extra evaluation.
     """
+    dtype = f0.dtype
 
-    def cond(state):
-        t, f_new, it, done = state
-        return (~done) & (it < max_iters)
+    def phi(t):
+        f_t, g_t = fun(w + t * d)
+        return f_t, g_t, jnp.dot(g_t, d)
 
-    def body(state):
-        t, _, it, _ = state
-        f_new, _ = fun(w + t * d)
-        ok = f_new <= f + _C1 * t * dderiv
-        # keep t on success; otherwise shrink for the next probe
-        t_next = jnp.where(ok, t, t * _BACKTRACK)
-        return t_next, f_new, it + 1, ok
+    def cond(s: _WolfeState):
+        return (~s.done) & (s.it < max_iters)
 
-    t, f_new, _, done = lax.while_loop(
-        cond, body, (t0, f, jnp.asarray(0), jnp.asarray(False))
+    def body(s: _WolfeState):
+        t = jnp.where(
+            s.bracketed, 0.5 * (s.t_lo + s.t_hi), s.t
+        )
+        f_t, g_t, dphi = phi(t)
+        armijo = f_t <= f0 + _C1 * t * dderiv
+        curv = jnp.abs(dphi) <= -_C2 * dderiv
+
+        # Case 1: Armijo fails (or no progress over the best point) — the
+        # minimum lies below t.
+        shrink = (~armijo) | (s.bracketed & (f_t >= s.f_lo))
+        # Case 2: both conditions hold — accept.
+        accept = armijo & curv
+        # Case 3: Armijo holds but the slope is still too negative/positive.
+        pos_slope = armijo & (~curv) & (dphi >= 0)
+
+        bracketed = s.bracketed | shrink | pos_slope
+        t_hi = jnp.where(
+            shrink, t, jnp.where(pos_slope, s.t_lo, s.t_hi)
+        )
+        t_lo = jnp.where(armijo & (~shrink), t, s.t_lo)
+        f_lo = jnp.where(armijo & (~shrink), f_t, s.f_lo)
+        # Unbracketed and still descending: expand. On accept keep the
+        # probed t (the loop stops; state.t IS the accepted step).
+        t_next = jnp.where(
+            accept, t, jnp.where(bracketed, t, t * 2.0)
+        )
+        return _WolfeState(
+            t=t_next, f_t=f_t, g_t=g_t,
+            t_lo=t_lo, f_lo=f_lo, t_hi=t_hi,
+            bracketed=bracketed, it=s.it + 1, done=accept,
+        )
+
+    init = _WolfeState(
+        t=t0,
+        f_t=f0,
+        g_t=g0,
+        t_lo=jnp.zeros((), dtype),
+        f_lo=f0,
+        t_hi=jnp.zeros((), dtype),
+        bracketed=jnp.asarray(False),
+        it=jnp.asarray(0),
+        done=jnp.asarray(False),
     )
-    return _LSResult(t=t, f_new=f_new, improved=done & (f_new < f))
+    s = lax.while_loop(cond, body, init)
+    # On exhaustion fall back to the best Armijo point found (t_lo).
+    ok = s.done | (s.t_lo > 0)
+    t = jnp.where(s.done, s.t, s.t_lo)
+    # The state's f_t/g_t are from the LAST probe, which is the accepted
+    # point exactly when done; otherwise re-evaluate at the fallback t.
+    f_t, g_t = lax.cond(
+        s.done, lambda: (s.f_t, s.g_t), lambda: fun(w + t * d)
+    )
+    return t, f_t, g_t, ok & (f_t < f0)
 
 
 class _State(NamedTuple):
@@ -167,8 +227,16 @@ def lbfgs_solve(
     ``tolerances`` can be supplied to skip the zero-coefficient evaluation
     (e.g. when the caller already computed it, or for exact parity control in
     warm starts).
+
+    Box constraints route to the bound-constrained solver (the reference's
+    LBFGSB, a gradient-projection active-set method) — projection after an
+    unconstrained step can stall on active-set boundaries.
     """
     config = config or OptimizerConfig()
+    if config.box_constraints is not None:
+        from photon_tpu.optim.lbfgsb import lbfgsb_solve
+
+        return lbfgsb_solve(fun, w0, config, tolerances=tolerances)
     m = config.num_corrections
     d = w0.shape[-1]
     dtype = w0.dtype
@@ -214,16 +282,13 @@ def lbfgs_solve(
             jnp.minimum(jnp.asarray(1.0, dtype), 1.0 / jnp.maximum(gnorm, 1e-12)),
             jnp.asarray(1.0, dtype),
         )
-        ls = _armijo_line_search(
-            fun, state.w, state.f, direction, dderiv, t0,
+        t, f_new, g_new, improved = _wolfe_line_search(
+            fun, state.w, state.f, state.g, direction, dderiv, t0,
             config.max_line_search_iterations,
         )
-
-        w_new = project_box(state.w + ls.t * direction, config.box_constraints)
-        f_new, g_new = fun(w_new)
-        # A failed line search (or a projection that un-does the decrease)
-        # means the objective cannot improve from here.
-        accept = ls.improved & (f_new < state.f)
+        w_new = state.w + t * direction
+        # A failed line search means the objective cannot improve from here.
+        accept = improved & (f_new < state.f)
         w_acc = jnp.where(accept, w_new, state.w)
         f_acc = jnp.where(accept, f_new, state.f)
         g_acc = jnp.where(accept, g_new, state.g)

@@ -1,0 +1,207 @@
+"""Bound-constrained L-BFGS: gradient-projection active set + subspace steps.
+
+TPU-native counterpart of the reference's LBFGSB (photon-lib
+optimization/LBFGSB.scala:39-92), which wraps Breeze's implementation of the
+Byrd-Lu-Nocedal-Zhu algorithm. The earlier rebuild handled bounds by
+projecting after an unconstrained L-BFGS step (LBFGS.scala:56-79 semantics);
+that can stall on active-set boundaries: the quasi-Newton direction keeps
+pointing into the bound, the projection keeps undoing the step, and the
+Armijo test keeps failing even though feasible descent exists in the free
+subspace.
+
+This solver follows the gradient-projection active-set structure as a pure
+``lax.while_loop`` program (jit/vmap-safe, like every other solver here):
+
+1. **Active set** from the projected gradient: a variable is active when it
+   sits at a bound whose gradient sign pushes outward.
+2. **Subspace minimization**: the two-loop L-BFGS direction of the FREE
+   gradient, re-masked to the free subspace — the limited-memory analog of
+   BLNZ's subspace step (their eq. (5.7) solved with the same curvature
+   pairs).
+3. **Projected Armijo line search** along the bent path w(t) = P(w + t d)
+   with the Bertsekas sufficient-decrease test
+   f(w(t)) <= f + c1 * g . (w(t) - w), which remains valid when the path
+   bends at bounds (a plain g.d test does not).
+
+Convergence uses the projected-gradient norm ||P(w - g) - w|| — zero exactly
+at KKT points — in the reference's convergence cascade.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_tpu.optim.base import (
+    ConvergenceReason,
+    OptResult,
+    OptimizerConfig,
+    Tolerances,
+    ValueAndGrad,
+    _l2norm,
+    absolute_tolerances,
+    convergence_code,
+)
+from photon_tpu.optim.lbfgs import (
+    _BACKTRACK,
+    _C1,
+    _History,
+    _push_history,
+    _two_loop_direction,
+)
+
+Array = jax.Array
+
+
+class _State(NamedTuple):
+    w: Array
+    f: Array
+    g: Array
+    hist: _History
+    iteration: Array
+    code: Array
+    losses: Array
+
+
+def _projected_gradient(w, g, lower, upper):
+    """P(w - g) - w: zero exactly at KKT points of the box problem."""
+    return jnp.clip(w - g, lower, upper) - w
+
+
+def lbfgsb_solve(
+    fun: ValueAndGrad,
+    w0: Array,
+    config: OptimizerConfig | None = None,
+    *,
+    tolerances: Tolerances | None = None,
+) -> OptResult:
+    """Minimize ``fun`` subject to ``config.box_constraints``; jit/vmap-safe.
+
+    Reference semantics: LBFGSB.scala:39-92 (a true bound-constrained
+    solver, not projection-after-step).
+    """
+    config = config or OptimizerConfig()
+    if config.box_constraints is None:
+        raise ValueError("lbfgsb_solve requires config.box_constraints")
+    lower, upper = config.box_constraints
+    lower = jnp.asarray(lower, dtype=w0.dtype)
+    upper = jnp.asarray(upper, dtype=w0.dtype)
+    m = config.num_corrections
+    d_dim = w0.shape[-1]
+    dtype = w0.dtype
+
+    tol = tolerances if tolerances is not None else absolute_tolerances(
+        fun, w0, config.tolerance)
+
+    w0 = jnp.clip(w0, lower, upper)
+    f0, g0 = fun(w0)
+    losses = jnp.full((config.max_iterations + 1,), f0, dtype=dtype)
+    init = _State(
+        w=w0,
+        f=f0,
+        g=g0,
+        hist=_History(
+            s=jnp.zeros((m, d_dim), dtype=dtype),
+            y=jnp.zeros((m, d_dim), dtype=dtype),
+            rho=jnp.zeros((m,), dtype=dtype),
+            count=jnp.asarray(0),
+        ),
+        iteration=jnp.asarray(0),
+        code=jnp.asarray(0, dtype=jnp.int32),
+        losses=losses,
+    )
+
+    def cond(state: _State):
+        return state.code == 0
+
+    def body(state: _State):
+        w, f, g = state.w, state.f, state.g
+        # 1. Active set: at a bound with the gradient pushing outward.
+        at_lower = (w <= lower) & (g > 0)
+        at_upper = (w >= upper) & (g < 0)
+        active = at_lower | at_upper
+        g_free = jnp.where(active, 0.0, g)
+
+        # 2. Subspace quasi-Newton direction (two-loop on the free
+        # gradient, re-masked so active variables do not move).
+        d = jnp.where(active, 0.0, _two_loop_direction(g_free, state.hist))
+        dderiv = jnp.dot(g_free, d)
+        # Safeguard: fall back to steepest feasible descent when the
+        # quasi-Newton direction is not a descent direction.
+        bad = dderiv >= 0.0
+        d = jnp.where(bad, -g_free, d)
+
+        # 3. Projected Armijo backtracking along the bent path. The probe
+        # carries the full gradient so the accepted point needs no extra
+        # objective evaluation.
+        def ls_cond(carry):
+            t, _w_t, _f_t, _g_t, it, done = carry
+            return (~done) & (it < config.max_line_search_iterations)
+
+        def ls_body(carry):
+            t, _, _, _, it, _ = carry
+            w_t = jnp.clip(w + t * d, lower, upper)
+            f_t, g_t = fun(w_t)
+            # Bertsekas projected-Armijo decrease: the model term follows
+            # the ACTUAL (bent) displacement, not t * g.d.
+            ok = f_t <= f + _C1 * jnp.dot(g, w_t - w)
+            t_next = jnp.where(ok, t, t * _BACKTRACK)
+            return t_next, w_t, f_t, g_t, it + 1, ok
+
+        # First step along an unscaled free gradient: temper by 1/|g| (the
+        # same first-iteration heuristic as lbfgs_solve — without it, an
+        # ill-scaled problem's first probe overshoots beyond what 25
+        # halvings can repair and the solve dies at w0).
+        gnorm = _l2norm(g_free)
+        t0 = jnp.where(
+            state.hist.count == 0,
+            jnp.minimum(
+                jnp.asarray(1.0, dtype), 1.0 / jnp.maximum(gnorm, 1e-12)
+            ),
+            jnp.asarray(1.0, dtype),
+        )
+        _, w_new, f_new, g_new, _, improved = lax.while_loop(
+            ls_cond, ls_body,
+            (t0, w, f, g, jnp.asarray(0), jnp.asarray(False)),
+        )
+        improved = improved & (f_new < f)
+
+        hist = jax.tree.map(
+            lambda a, b: jnp.where(improved, a, b),
+            _push_history(state.hist, w_new - w, g_new - g),
+            state.hist,
+        )
+        w_acc = jnp.where(improved, w_new, w)
+        f_acc = jnp.where(improved, f_new, f)
+        g_acc = jnp.where(improved, g_new, g)
+
+        iteration = state.iteration + 1
+        losses = state.losses.at[iteration].set(f_acc)
+        pg_norm = _l2norm(_projected_gradient(w_acc, g_acc, lower, upper))
+        code = convergence_code(
+            iteration=iteration,
+            max_iterations=config.max_iterations,
+            loss_delta=f - f_acc,
+            gradient_norm=pg_norm,
+            tol=tol,
+            not_improving=~improved,
+        )
+        return _State(
+            w=w_acc, f=f_acc, g=g_acc, hist=hist,
+            iteration=iteration, code=code, losses=losses,
+        )
+
+    final = lax.while_loop(cond, body, init)
+    return OptResult(
+        coefficients=final.w,
+        value=final.f,
+        gradient_norm=_l2norm(
+            _projected_gradient(final.w, final.g, lower, upper)
+        ),
+        iterations=final.iteration,
+        convergence_reason=final.code,
+        loss_history=final.losses,
+    )

@@ -1,0 +1,88 @@
+"""Event system: listener registry + estimator-emitted training events.
+
+Reference: photon-client event/EventEmitter.scala:24 (listener registry with
+synchronous sendEvent fan-out) and Event.scala:65 (typed event classes) —
+wired here to the GAME path instead of the legacy driver.
+"""
+
+import numpy as np
+import pytest
+
+from photon_tpu import optim
+from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
+from photon_tpu.data.dataset import DenseFeatures
+from photon_tpu.data.game_data import make_game_dataset
+from photon_tpu.data.random_effect import RandomEffectDataConfiguration
+from photon_tpu.estimators.game_estimator import (
+    FixedEffectCoordinateConfiguration,
+    GameEstimator,
+    RandomEffectCoordinateConfiguration,
+)
+from photon_tpu.events import (
+    CoordinateUpdateEvent,
+    EventEmitter,
+    FitEndEvent,
+    PhotonEvent,
+)
+from photon_tpu.types import TaskType
+
+
+def test_emitter_registry():
+    got = []
+    emitter = EventEmitter()
+    listener = got.append
+    emitter.add_listener(listener)
+    e = PhotonEvent()
+    emitter.send_event(e)
+    assert got == [e]
+    emitter.remove_listener(listener)
+    emitter.send_event(e)
+    assert got == [e]
+
+
+def test_listener_exception_propagates():
+    emitter = EventEmitter([lambda e: (_ for _ in ()).throw(RuntimeError("x"))])
+    with pytest.raises(RuntimeError):
+        emitter.send_event(PhotonEvent())
+
+
+def test_estimator_emits_training_events(rng):
+    n, d, e = 300, 5, 8
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    x[:, -1] = 1.0
+    users = rng.integers(0, e, size=n)
+    y = x @ rng.normal(size=d) + 0.1 * rng.normal(size=n)
+    game = make_game_dataset(
+        y, {"s": DenseFeatures(x)}, id_tags={"u": users},
+    )
+
+    events = []
+    est = GameEstimator(
+        TaskType.LINEAR_REGRESSION,
+        {
+            "global": FixedEffectCoordinateConfiguration(
+                "s", GLMOptimizationConfiguration(
+                    regularization=optim.RegularizationContext(
+                        optim.RegularizationType.L2),
+                    regularization_weight=0.1)),
+            "per-u": RandomEffectCoordinateConfiguration(
+                RandomEffectDataConfiguration("u", "s"),
+                GLMOptimizationConfiguration(
+                    regularization=optim.RegularizationContext(
+                        optim.RegularizationType.L2),
+                    regularization_weight=1.0)),
+        },
+        intercept_indices={"s": d - 1},
+        num_iterations=2,
+        listeners=[events.append],
+    )
+    results = est.fit(game)
+
+    updates = [ev for ev in events if isinstance(ev, CoordinateUpdateEvent)]
+    ends = [ev for ev in events if isinstance(ev, FitEndEvent)]
+    # 2 CD iterations x 2 coordinates, one config.
+    assert [(u.iteration, u.coordinate_id) for u in updates] == [
+        (0, "global"), (0, "per-u"), (1, "global"), (1, "per-u")]
+    assert all(u.seconds >= 0 for u in updates)
+    assert len(ends) == 1 and ends[0].config_index == 0
+    assert ends[0].result is results[0]

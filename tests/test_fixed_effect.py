@@ -179,8 +179,13 @@ def test_a9a_golden_auc():
     """
     batch = read_libsvm(A9A, dtype=np.float64)
     icept = batch.num_features - 1
+    # The strong-Wolfe line search keeps making real progress where the
+    # old backtracking-only search spuriously hit FUNCTION_VALUES at 100
+    # iterations; give the solver enough budget to genuinely converge.
     coord = FixedEffectCoordinate(batch, _problem(
-        config=_l2_config(1.0), icept=icept))
+        config=_l2_config(
+            1.0, optimizer=optim.OptimizerConfig.lbfgs(max_iterations=400)),
+        icept=icept))
     model, result = coord.train()
     scores = coord.score(model)
     auc = float(ev.auc_roc(scores, batch.labels))
