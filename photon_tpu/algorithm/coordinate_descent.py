@@ -221,23 +221,18 @@ class CoordinateDescent:
                         "CD iter %d coordinate %s trained (%.2fs)",
                         it, cid, seconds,
                     )
-                history.append(CoordinateUpdateRecord(
+                record = CoordinateUpdateRecord(
                     iteration=it,
                     coordinate_id=cid,
                     seconds=seconds,
                     diagnostics=diag,
                     evaluation=evaluation,
-                ))
+                )
+                history.append(record)
                 if self.emitter is not None:
                     from photon_tpu.events import CoordinateUpdateEvent
 
-                    self.emitter.send_event(CoordinateUpdateEvent(
-                        iteration=it,
-                        coordinate_id=cid,
-                        seconds=seconds,
-                        diagnostics=diag,
-                        evaluation=evaluation,
-                    ))
+                    self.emitter.send_event(CoordinateUpdateEvent(record))
 
         final = GameModel(dict(models))
         if best_model is None:

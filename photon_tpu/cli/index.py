@@ -44,19 +44,21 @@ def parse_shard_spec(specs: list[str] | None) -> dict[str, list[str]]:
 
 
 def build_shard_vocabularies(
-    records: list[dict], shard_bags: dict[str, list[str]]
+    records, shard_bags: dict[str, list[str]]
 ) -> dict[str, list[tuple[str, str]]]:
     """Distinct (name, term) pairs per shard, sorted — the NameAndTerm set
-    (NameAndTermFeatureBagsDriver semantics over in-memory records)."""
-    out: dict[str, list[tuple[str, str]]] = {}
-    for shard, bags in shard_bags.items():
-        seen: set[tuple[str, str]] = set()
-        for rec in records:
+    (NameAndTermFeatureBagsDriver semantics). ``records`` may be any
+    iterable (including a streaming block decoder): one pass collects every
+    shard's set, so peak memory is the vocabularies themselves, never a
+    record list."""
+    seen: dict[str, set] = {shard: set() for shard in shard_bags}
+    for rec in records:
+        for shard, bags in shard_bags.items():
+            ks = seen[shard]
             for bag in bags:
                 for ntv in rec.get(bag) or ():
-                    seen.add((ntv["name"], ntv["term"]))
-        out[shard] = sorted(seen)
-    return out
+                    ks.add((ntv["name"], ntv["term"]))
+    return {shard: sorted(ks) for shard, ks in seen.items()}
 
 
 def main(argv=None) -> int:
@@ -73,32 +75,44 @@ def main(argv=None) -> int:
                              "'features=features'")
     parser.add_argument("--no-intercept", action="store_true",
                         help="do not reserve an intercept slot")
+    parser.add_argument("--hashed", action="store_true",
+                        help="write npz-backed hashed index maps (the "
+                             "PalDB analog for multi-million-feature "
+                             "vocabularies)")
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING)
     log = logging.getLogger("photon.index")
 
-    from photon_tpu.data.index_map import IndexMap
+    from photon_tpu.data.index_map import HashedIndexMap, IndexMap
     from photon_tpu.io import avro
     from photon_tpu.types import make_feature_key
 
     shard_bags = parse_shard_spec(args.shards)
-    records: list[dict] = []
-    for path in args.input:
-        records.extend(avro.read_container_dir(path))
-    if not records:
-        raise ValueError(f"no records in {args.input}")
 
-    vocabularies = build_shard_vocabularies(records, shard_bags)
+    def stream():
+        found = False
+        for path in args.input:
+            for rec in avro.iter_container_dir(path):
+                found = True
+                yield rec
+        if not found:
+            raise ValueError(f"no records in {args.input}")
+
+    vocabularies = build_shard_vocabularies(stream(), shard_bags)
     os.makedirs(args.output, exist_ok=True)
     summary = {}
     for shard, pairs in vocabularies.items():
-        imap = IndexMap.from_feature_names(
-            [make_feature_key(n, t) for n, t in pairs],
-            add_intercept=not args.no_intercept,
-        )
-        imap.save(os.path.join(args.output, f"{shard}.index.json"))
+        keys = [make_feature_key(n, t) for n, t in pairs]
+        if args.hashed:
+            imap = HashedIndexMap.from_feature_names(
+                keys, add_intercept=not args.no_intercept)
+            imap.save(os.path.join(args.output, f"{shard}.index.npz"))
+        else:
+            imap = IndexMap.from_feature_names(
+                keys, add_intercept=not args.no_intercept)
+            imap.save(os.path.join(args.output, f"{shard}.index.json"))
         # Reference feature-lists format: "name<TAB>term" per line.
         with open(os.path.join(args.output, shard), "w") as f:
             for n, t in pairs:
@@ -110,9 +124,11 @@ def main(argv=None) -> int:
 
 
 def load_index_maps(directory: str) -> dict[str, "object"]:
-    """Load every ``<shard>.index.json`` under a ``photon index`` output dir
-    (the train/score-side counterpart of PalDBIndexMapLoader)."""
-    from photon_tpu.data.index_map import IndexMap
+    """Load every ``<shard>.index.json`` / ``<shard>.index.npz`` under a
+    ``photon index`` output dir (the train/score-side counterpart of
+    PalDBIndexMapLoader; npz maps decompress into compact numpy arrays —
+    tens of bytes per feature instead of per-entry Python objects)."""
+    from photon_tpu.data.index_map import HashedIndexMap, IndexMap
 
     out = {}
     for name in sorted(os.listdir(directory)):
@@ -120,8 +136,13 @@ def load_index_maps(directory: str) -> dict[str, "object"]:
             out[name[: -len(".index.json")]] = IndexMap.load(
                 os.path.join(directory, name)
             )
+        elif name.endswith(".index.npz"):
+            out[name[: -len(".index.npz")]] = HashedIndexMap.load(
+                os.path.join(directory, name)
+            )
     if not out:
-        raise ValueError(f"no *.index.json files under {directory}")
+        raise ValueError(f"no *.index.json / *.index.npz files under "
+                         f"{directory}")
     return out
 
 

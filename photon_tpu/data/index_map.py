@@ -89,3 +89,137 @@ class IndexMap:
     @staticmethod
     def load(path: str | Path) -> "IndexMap":
         return IndexMap(json.loads(Path(path).read_text()))
+
+
+class HashedIndexMap:
+    """Array-backed feature map for multi-million-feature vocabularies.
+
+    TPU-native counterpart of PalDBIndexMap (photon-client
+    index/PalDBIndexMap.scala:43): where the reference sidesteps JVM heap
+    limits with partitioned off-heap PalDB stores, this sidesteps Python
+    dict overhead (~100+ bytes per entry plus per-string objects) with four
+    numpy arrays — sorted 64-bit key hashes, their indices, and an
+    offset-indexed UTF-8 name blob (~25 bytes/feature total at typical key
+    lengths, a ~10x reduction). Lookup is a binary search plus an exact
+    name check against the blob, so hash collisions between a probe and a
+    stored key cannot mis-resolve. Persisted as one ``.npz``.
+
+    Same surface as ``IndexMap`` (get_index / get_feature_name / len /
+    contains / items / intercept) and the same deterministic index
+    assignment (sorted keys, intercept last), so the two are
+    interchangeable everywhere a shard map flows.
+    """
+
+    def __init__(self, hashes, indices, pos_by_index, offsets, blob):
+        self._hashes = hashes  # [n] uint64, sorted
+        self._indices = indices  # [n] int64 — index at hash position
+        self._pos_by_index = pos_by_index  # [n] int64 — hash position by idx
+        self._offsets = offsets  # [n + 1] int64 into blob, hash order
+        self._blob = blob  # uint8 utf-8 concatenation, hash order
+
+    @staticmethod
+    def _hash(key: str):
+        import hashlib
+
+        import numpy as np
+
+        return np.uint64(int.from_bytes(
+            hashlib.blake2b(key.encode(), digest_size=8).digest(), "little"
+        ))
+
+    @staticmethod
+    def from_feature_names(names, *, add_intercept: bool = True):
+        import numpy as np
+
+        uniq = sorted(set(str(n) for n in names) - {INTERCEPT_KEY})
+        if add_intercept:
+            uniq.append(INTERCEPT_KEY)
+        n = len(uniq)
+        hashes = np.empty(n, dtype=np.uint64)
+        for i, k in enumerate(uniq):
+            hashes[i] = HashedIndexMap._hash(k)
+        order = np.argsort(hashes, kind="stable")
+        hashes = hashes[order]
+        if n and (hashes[1:] == hashes[:-1]).any():
+            raise ValueError(
+                "64-bit hash collision between distinct feature keys; "
+                "use the dict-backed IndexMap for this vocabulary"
+            )
+        indices = order.astype(np.int64)  # uniq position == index
+        pos_by_index = np.empty(n, dtype=np.int64)
+        pos_by_index[indices] = np.arange(n, dtype=np.int64)
+        encoded = [uniq[i].encode() for i in order]
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        blob = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+        return HashedIndexMap(hashes, indices, pos_by_index, offsets, blob)
+
+    def _name_at_pos(self, pos: int) -> str:
+        lo, hi = int(self._offsets[pos]), int(self._offsets[pos + 1])
+        return bytes(self._blob[lo:hi]).decode()
+
+    def get_index(self, name: FeatureKey) -> int | None:
+        import numpy as np
+
+        if self._hashes.size == 0:
+            return None
+        h = self._hash(str(name))
+        pos = int(np.searchsorted(self._hashes, h))
+        if pos >= self._hashes.size or self._hashes[pos] != h:
+            return None
+        # Exact verification against the blob: a probe key that collides
+        # with a stored hash must not resolve to the stored key's index.
+        if self._name_at_pos(pos) != str(name):
+            return None
+        return int(self._indices[pos])
+
+    def get_feature_name(self, index: int) -> FeatureKey | None:
+        if not 0 <= index < len(self):
+            return None
+        return self._name_at_pos(int(self._pos_by_index[index]))
+
+    def __len__(self) -> int:
+        return int(self._hashes.size)
+
+    def __contains__(self, name: FeatureKey) -> bool:
+        return self.get_index(name) is not None
+
+    def items(self):
+        for idx in range(len(self)):
+            yield self.get_feature_name(idx), idx
+
+    @property
+    def has_intercept(self) -> bool:
+        return self.get_index(INTERCEPT_KEY) is not None
+
+    @property
+    def intercept_index(self) -> int | None:
+        return self.get_index(INTERCEPT_KEY)
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, path: str | Path) -> None:
+        import numpy as np
+
+        # Write through a file object so the archive lands at EXACTLY the
+        # given path (np.savez_compressed on a string appends ".npz",
+        # silently breaking the save/load round trip for other suffixes).
+        with open(path, "wb") as f:
+            np.savez_compressed(
+                f,
+                hashes=self._hashes,
+                indices=self._indices,
+                pos_by_index=self._pos_by_index,
+                offsets=self._offsets,
+                blob=self._blob,
+            )
+
+    @staticmethod
+    def load(path: str | Path) -> "HashedIndexMap":
+        import numpy as np
+
+        with np.load(str(path)) as z:
+            return HashedIndexMap(
+                z["hashes"], z["indices"], z["pos_by_index"],
+                z["offsets"], z["blob"],
+            )

@@ -27,13 +27,20 @@ class PhotonEvent:
 @dataclasses.dataclass(frozen=True)
 class CoordinateUpdateEvent(PhotonEvent):
     """One coordinate update finished (the per-iteration log record of
-    CoordinateDescent.descend, CoordinateDescent.scala:322-333)."""
+    CoordinateDescent.descend, CoordinateDescent.scala:322-333).
 
-    iteration: int
-    coordinate_id: str
-    seconds: float
-    diagnostics: Any
-    evaluation: Any  # EvaluationResults | None
+    Wraps the history record so the event surface cannot drift from it.
+    """
+
+    record: Any  # CoordinateUpdateRecord
+
+    @property
+    def iteration(self) -> int:
+        return self.record.iteration
+
+    @property
+    def coordinate_id(self) -> str:
+        return self.record.coordinate_id
 
 
 @dataclasses.dataclass(frozen=True)

@@ -341,8 +341,14 @@ def write_container(
         flush()
 
 
-def read_container(path: str) -> tuple[dict, list]:
-    """Read an Avro object container file -> (schema_json, records)."""
+def iter_container(path: str):
+    """Stream an Avro object container file block by block.
+
+    Generator of decoded records: at any moment only ONE decompressed block
+    (``sync_interval`` records, default 4000) of Python dicts is alive —
+    the O(batch) decode the ingest pipeline builds its arrays from. The
+    file handle closes when the generator is exhausted or dropped.
+    """
     with open(path, "rb") as f:
         if f.read(4) != MAGIC:
             raise ValueError(f"{path}: not an Avro container file")
@@ -351,7 +357,6 @@ def read_container(path: str) -> tuple[dict, list]:
         codec = meta.get("avro.codec", b"null").decode()
         sync = f.read(SYNC_SIZE)
         schema = Schema(schema_json)
-        records = []
         while True:
             try:
                 count = _read_long(f)
@@ -365,20 +370,38 @@ def read_container(path: str) -> tuple[dict, list]:
                 raise ValueError(f"unsupported codec {codec!r}")
             block = io.BytesIO(data)
             for _ in range(count):
-                records.append(_decode(block, schema.root))
+                yield _decode(block, schema.root)
             marker = f.read(SYNC_SIZE)
             if marker != sync:
                 raise ValueError(f"{path}: sync marker mismatch")
-    return schema_json, records
+
+
+def iter_container_dir(path: str):
+    """Stream all part files of a file-or-directory of Avro containers
+    (the HDFS part-* layout of AvroUtils.readAvroFiles)."""
+    if os.path.isfile(path):
+        yield from iter_container(path)
+        return
+    for name in sorted(os.listdir(path)):
+        if name.endswith(".avro"):
+            yield from iter_container(os.path.join(path, name))
+
+
+def container_schema(path: str) -> dict:
+    """Read just the schema of a container file (no record decode)."""
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        meta = _decode(f, _META_SCHEMA)
+        return json.loads(meta["avro.schema"].decode())
+
+
+def read_container(path: str) -> tuple[dict, list]:
+    """Read an Avro object container file -> (schema_json, records)."""
+    return container_schema(path), list(iter_container(path))
 
 
 def read_container_dir(path: str) -> list:
-    """Read all part files of a directory of Avro containers (the HDFS
-    part-* layout of AvroUtils.readAvroFiles)."""
-    if os.path.isfile(path):
-        return read_container(path)[1]
-    records = []
-    for name in sorted(os.listdir(path)):
-        if name.endswith(".avro"):
-            records.extend(read_container(os.path.join(path, name))[1])
-    return records
+    """Read all part files of a directory of Avro containers, materialized.
+    Prefer ``iter_container_dir`` for large inputs."""
+    return list(iter_container_dir(path))

@@ -20,7 +20,7 @@ from __future__ import annotations
 import numpy as np
 
 from photon_tpu.data.game_data import GameDataset, make_game_dataset
-from photon_tpu.data.dataset import SparseFeatures, rows_to_ell
+from photon_tpu.data.dataset import SparseFeatures
 from photon_tpu.data.index_map import IndexMap
 from photon_tpu.io import avro
 from photon_tpu.types import make_feature_key, split_feature_key
@@ -52,24 +52,16 @@ def read_training_examples(
     """Read a TrainingExampleAvro file/dir into a GameDataset.
 
     ``id_tag_names`` picks metadataMap entries to expose as id tags; when
-    None all metadata keys found in the first record are used. ``records``
-    supplies already-parsed Avro records for ``path`` to skip a re-parse.
+    None every metadata key found in the data is used. ``records`` supplies
+    already-parsed Avro records for ``path`` to skip a re-parse; without it
+    the file is STREAMED block by block (peak host memory is the output
+    arrays plus one decode chunk, not a list of record dicts).
     """
-    if records is None:
-        records = avro.read_container_dir(path)
-    if not records:
-        raise ValueError(f"no records in {path}")
-    if id_tag_names is None:
-        # Union over ALL records: any key may be absent from the first one.
-        found: set[str] = set()
-        for rec in records:
-            found.update((rec.get("metadataMap") or {}).keys())
-        id_tag_names = sorted(found)
     game, maps = read_merged(
         path,
         feature_shards={"features": ["features"]},
         index_maps=None if index_map is None else {"features": index_map},
-        id_tag_names=id_tag_names,
+        id_tag_names="auto" if id_tag_names is None else id_tag_names,
         response_field="label",
         add_intercept=add_intercept,
         dtype=dtype,
@@ -78,13 +70,54 @@ def read_training_examples(
     return game, maps["features"]
 
 
+_CHUNK_ROWS = 65_536
+
+
+class _EllBuilder:
+    """Incremental ELL assembly: rows arrive in chunks, each chunk packs at
+    its own width, chunks concatenate (padded to the global max width) at
+    the end. Peak memory = the final arrays + one chunk of Python rows —
+    never a whole-dataset list of per-row tuples."""
+
+    def __init__(self, dtype=np.float32):
+        self.chunks: list[tuple[np.ndarray, np.ndarray]] = []
+        self.k = 1
+        self.dtype = dtype
+
+    def add_chunk(self, rows: list) -> None:
+        if not rows:
+            return
+        k_c = max(max((len(r) for r in rows), default=0), 1)
+        self.k = max(self.k, k_c)
+        idx = np.zeros((len(rows), k_c), dtype=np.int32)
+        val = np.zeros((len(rows), k_c), dtype=self.dtype)
+        for i, row in enumerate(rows):
+            for j, (fi, fv) in enumerate(row):
+                idx[i, j] = fi
+                val[i, j] = fv
+        self.chunks.append((idx, val))
+
+    def finish(self) -> tuple[np.ndarray, np.ndarray]:
+        if not self.chunks:
+            return (np.zeros((0, 1), np.int32), np.zeros((0, 1), self.dtype))
+        k = self.k
+        idx = np.concatenate([
+            np.pad(i, ((0, 0), (0, k - i.shape[1]))) for i, _ in self.chunks
+        ])
+        val = np.concatenate([
+            np.pad(v, ((0, 0), (0, k - v.shape[1]))) for _, v in self.chunks
+        ])
+        self.chunks.clear()
+        return idx, val
+
+
 def read_merged(
     path: str,
     *,
     feature_shards: dict[str, list[str]],
     index_maps: dict[str, IndexMap] | None = None,
     id_columns: list[str] | None = None,
-    id_tag_names: list[str] | None = None,
+    id_tag_names=None,  # list[str] | None | "auto"
     response_field: str | None = None,
     add_intercept: bool | dict[str, bool] = True,
     dtype=jnp.float32,
@@ -98,66 +131,138 @@ def read_merged(
     Music layout's ``userFeatures``/``songFeatures``/``features`` bags —
     packed into its own ELL matrix against its own index map. ``id_columns``
     exposes top-level record fields (userId, songId, ...) as id tags;
-    ``id_tag_names`` additionally picks metadataMap entries. The response
-    comes from ``response_field`` (auto: "response" then "label").
-    ``add_intercept`` may be per-shard (FeatureShardConfiguration's
-    hasIntercept flag) or one bool for all shards.
+    ``id_tag_names`` additionally picks metadataMap entries (``"auto"`` =
+    every key found in the data). The response comes from ``response_field``
+    (auto: "response" then "label"). ``add_intercept`` may be per-shard
+    (FeatureShardConfiguration's hasIntercept flag) or one bool for all.
+
+    STREAMING: without a pre-parsed ``records`` list the file is decoded
+    block by block, twice when a scan pass is needed (vocabulary build /
+    metadata-key discovery / response-field probe) — peak host memory is
+    the output arrays plus one decode block, the O(batch) requirement of
+    the ingest pipeline (the reference amortizes the same passes across a
+    cluster, AvroDataReader.scala:85).
     """
     def shard_intercept(shard: str) -> bool:
         if isinstance(add_intercept, dict):
             return add_intercept.get(shard, True)
         return add_intercept
-    if records is None:
-        records = avro.read_container_dir(path)
-    if not records:
-        raise ValueError(f"no records in {path}")
 
-    if response_field is None:
-        for candidate in ("response", "label"):
-            if candidate in records[0]:
-                response_field = candidate
-                break
-        else:
-            raise ValueError(
-                "records carry neither 'response' nor 'label'; pass "
-                "response_field explicitly")
+    if records is not None and not isinstance(records, (list, tuple)):
+        # The scan + build passes each iterate; a one-shot iterable would
+        # be exhausted by the first.
+        records = list(records)
 
-    out_maps: dict[str, IndexMap] = {}
-    for shard, bags in feature_shards.items():
-        if index_maps is not None and shard in index_maps:
-            out_maps[shard] = index_maps[shard]
-            continue
-        keys = set()
-        for rec in records:
-            for bag in bags:
-                for f in rec.get(bag) or ():
-                    keys.add(make_feature_key(f["name"], f["term"]))
-        out_maps[shard] = IndexMap.from_feature_names(
-            keys, add_intercept=shard_intercept(shard))
+    def stream():
+        if records is not None:
+            return iter(records)
+        return avro.iter_container_dir(path)
 
-    n = len(records)
-    labels = np.empty(n)
-    offsets = np.zeros(n)
-    weights = np.ones(n)
-    uids = np.empty(n, dtype=np.int64)
-    shard_rows: dict[str, list] = {shard: [] for shard in feature_shards}
+    missing_maps = [
+        s for s in feature_shards
+        if index_maps is None or s not in index_maps
+    ]
+    need_scan = (
+        bool(missing_maps) or id_tag_names == "auto"
+        or response_field is None
+    )
+    # With prebuilt maps and explicit tags, the only scan need is the
+    # response-field probe — one record, not a full decode pass.
+    probe_only = not missing_maps and id_tag_names != "auto"
+    out_maps: dict[str, IndexMap] = dict(
+        (s, index_maps[s]) for s in feature_shards
+        if index_maps is not None and s in index_maps
+    )
+    if need_scan:
+        keysets: dict[str, set] = {s: set() for s in missing_maps}
+        meta_keys: set[str] = set()
+        first = None
+        for rec in stream():
+            if first is None:
+                first = rec
+                if probe_only:
+                    break
+            for shard in missing_maps:
+                ks = keysets[shard]
+                for bag in feature_shards[shard]:
+                    for f in rec.get(bag) or ():
+                        ks.add(make_feature_key(f["name"], f["term"]))
+            if id_tag_names == "auto":
+                meta_keys.update((rec.get("metadataMap") or {}).keys())
+        if first is None:
+            raise ValueError(f"no records in {path}")
+        if response_field is None:
+            for candidate in ("response", "label"):
+                if candidate in first:
+                    response_field = candidate
+                    break
+            else:
+                raise ValueError(
+                    "records carry neither 'response' nor 'label'; pass "
+                    "response_field explicitly")
+        if id_tag_names == "auto":
+            id_tag_names = sorted(meta_keys)
+        for shard in missing_maps:
+            out_maps[shard] = IndexMap.from_feature_names(
+                keysets.pop(shard), add_intercept=shard_intercept(shard))
+    elif id_tag_names == "auto":
+        id_tag_names = []
+
     id_columns = list(id_columns or ())
     overlap = set(id_columns) & set(id_tag_names or ())
     if overlap:
         raise ValueError(
             f"id name(s) {sorted(overlap)} listed in both id_columns and "
             "id_tag_names; each id tag must come from exactly one source")
-    tags: dict[str, list] = {t: [] for t in id_columns}
-    for t in id_tag_names or ():
-        tags.setdefault(t, [])
 
-    for i, rec in enumerate(records):
-        labels[i] = rec[response_field]
-        if rec.get("offset") is not None:
-            offsets[i] = rec["offset"]
-        if rec.get("weight") is not None:
-            weights[i] = rec["weight"]
-        uids[i] = _uid_to_int(rec.get("uid"), i)
+    np_dtype = np.dtype(dtype)
+    labels_chunks: list[np.ndarray] = []
+    offsets_chunks: list[np.ndarray] = []
+    weights_chunks: list[np.ndarray] = []
+    uids_chunks: list[np.ndarray] = []
+    builders = {s: _EllBuilder(np_dtype) for s in feature_shards}
+    tag_names = list(id_columns)
+    for t in id_tag_names or ():
+        if t not in tag_names:
+            tag_names.append(t)
+    # Tag values flush to numpy string-array chunks like every other
+    # column — a per-row Python list would break the O(batch) contract.
+    tag_chunks: dict[str, list] = {t: [] for t in tag_names}
+
+    # Chunk-local accumulators, flushed to arrays every _CHUNK_ROWS rows.
+    c_labels: list = []
+    c_offsets: list = []
+    c_weights: list = []
+    c_uids: list = []
+    c_rows: dict[str, list] = {s: [] for s in feature_shards}
+    c_tags: dict[str, list] = {t: [] for t in tag_names}
+
+    def flush():
+        if not c_labels:
+            return
+        labels_chunks.append(np.asarray(c_labels, dtype=np.float64))
+        offsets_chunks.append(np.asarray(c_offsets, dtype=np.float64))
+        weights_chunks.append(np.asarray(c_weights, dtype=np.float64))
+        uids_chunks.append(np.asarray(c_uids, dtype=np.int64))
+        for s in feature_shards:
+            builders[s].add_chunk(c_rows[s])
+            c_rows[s].clear()
+        for t in tag_names:
+            tag_chunks[t].append(np.asarray(c_tags[t]))
+            c_tags[t].clear()
+        c_labels.clear()
+        c_offsets.clear()
+        c_weights.clear()
+        c_uids.clear()
+
+    i = -1
+    for i, rec in enumerate(stream()):
+        c_labels.append(rec[response_field])
+        c_offsets.append(
+            rec["offset"] if rec.get("offset") is not None else 0.0)
+        c_weights.append(
+            rec["weight"] if rec.get("weight") is not None else 1.0)
+        c_uids.append(_uid_to_int(rec.get("uid"), i))
         for shard, bags in feature_shards.items():
             imap = out_maps[shard]
             row = []
@@ -169,33 +274,40 @@ def read_merged(
                         row.append((idx, float(f["value"])))
             if imap.intercept_index is not None:
                 row.append((imap.intercept_index, 1.0))
-            shard_rows[shard].append(row)
+            c_rows[shard].append(row)
         for col in id_columns:
             if col not in rec or rec[col] is None:
                 raise ValueError(f"record {i} is missing id column {col!r}")
-            tags[col].append(rec[col])
+            c_tags[col].append(rec[col])
         meta = rec.get("metadataMap") or {}
         for t in id_tag_names or ():
             if t not in meta:
                 raise ValueError(
                     f"record {i} is missing id tag {t!r} in metadataMap")
-            tags[t].append(meta[t])
+            c_tags[t].append(meta[t])
+        if len(c_labels) >= _CHUNK_ROWS:
+            flush()
+    flush()
+    if i < 0:
+        raise ValueError(f"no records in {path}")
 
     shards = {}
     for shard in feature_shards:
-        indices, values = rows_to_ell(
-            shard_rows[shard], len(out_maps[shard]))
+        indices, values = builders[shard].finish()
         # Numpy-backed: make_game_dataset keeps the host mirror (the
         # dataset-build planner reads it) and pushes the device copy once.
         shards[shard] = SparseFeatures(
             indices, values, len(out_maps[shard]))
     game = make_game_dataset(
-        labels,
+        np.concatenate(labels_chunks),
         shards,
-        offsets=offsets,
-        weights=weights,
-        id_tags={t: np.asarray(v) for t, v in tags.items() if v},
-        uids=uids,
+        offsets=np.concatenate(offsets_chunks),
+        weights=np.concatenate(weights_chunks),
+        id_tags={
+            t: np.concatenate(chunks)
+            for t, chunks in tag_chunks.items() if chunks
+        },
+        uids=np.concatenate(uids_chunks),
         dtype=dtype,
     )
     return game, out_maps

@@ -83,6 +83,9 @@ def test_estimator_emits_training_events(rng):
     # 2 CD iterations x 2 coordinates, one config.
     assert [(u.iteration, u.coordinate_id) for u in updates] == [
         (0, "global"), (0, "per-u"), (1, "global"), (1, "per-u")]
-    assert all(u.seconds >= 0 for u in updates)
+    assert all(u.record.seconds >= 0 for u in updates)
+    # Events wrap the exact history records.
+    assert [u.record for u in updates] == list(
+        results[0].descent.history)
     assert len(ends) == 1 and ends[0].config_index == 0
     assert ends[0].result is results[0]

