@@ -57,31 +57,86 @@ from photon_tpu.types import TaskType
 Array = jax.Array
 
 
-@dataclasses.dataclass(frozen=True)
 class RandomEffectTrainingStats:
-    """Aggregate per-entity solver diagnostics.
+    """Aggregate per-entity solver diagnostics, fetched LAZILY.
 
     Reference: RandomEffectOptimizationTracker (optimization/
     RandomEffectOptimizationTracker.scala:89) — counts of convergence reasons
     plus iteration stats over entities.
+
+    The diagnostic arrays live on the device until an attribute is read:
+    fetching them eagerly would insert a device->host sync into every
+    coordinate update of the CD loop (on a remote-attached chip that sync
+    costs more than the solve itself). Training code threads this object
+    into the history without touching it; summaries/tests that read it pay
+    the one coalesced transfer then. Unread stats pin two [num_entities]
+    int32 device buffers per update — bounded well below the [E, S]
+    coefficient matrices the same history records already retain, so no
+    explicit release hook is needed.
     """
 
-    convergence_reason_counts: dict[str, int]
-    iterations_mean: float
-    iterations_max: int
-    num_entities: int
+    def __init__(self, reasons=None, iterations=None, *, device=None):
+        # device: (reason device arrays, iteration device arrays,
+        #          host keep-masks) — one pull on first attribute access.
+        self._device = device
+        self._host = None
+        if device is None:
+            self._host = (
+                np.asarray(reasons) if reasons is not None
+                else np.empty(0, np.int32),
+                np.asarray(iterations) if iterations is not None
+                else np.empty(0, np.int32),
+            )
 
     @staticmethod
     def from_arrays(reasons: np.ndarray, iterations: np.ndarray):
+        return RandomEffectTrainingStats(reasons, iterations)
+
+    @staticmethod
+    def from_device(reason_arrays, iteration_arrays, keep_masks):
+        return RandomEffectTrainingStats(
+            device=(reason_arrays, iteration_arrays, keep_masks)
+        )
+
+    def _materialize(self):
+        if self._host is None:
+            reasons_d, iters_d, keeps = self._device
+            keep = np.concatenate(keeps) if keeps else np.empty(0, bool)
+            # One coalesced fetch of all blocks' diagnostics.
+            reasons = (
+                np.asarray(jnp.concatenate(reasons_d)) if reasons_d
+                else np.empty(0, np.int32)
+            )
+            iters = (
+                np.asarray(jnp.concatenate(iters_d)) if iters_d
+                else np.empty(0, np.int32)
+            )
+            self._host = (reasons[keep], iters[keep])
+            self._device = None
+        return self._host
+
+    @property
+    def convergence_reason_counts(self) -> dict[str, int]:
+        reasons, _ = self._materialize()
         counts: dict[str, int] = {}
         for code, cnt in zip(*np.unique(reasons, return_counts=True)):
             counts[optim.ConvergenceReason(int(code)).name] = int(cnt)
-        return RandomEffectTrainingStats(
-            convergence_reason_counts=counts,
-            iterations_mean=float(iterations.mean()) if iterations.size else 0.0,
-            iterations_max=int(iterations.max()) if iterations.size else 0,
-            num_entities=int(iterations.size),
-        )
+        return counts
+
+    @property
+    def iterations_mean(self) -> float:
+        _, iters = self._materialize()
+        return float(iters.mean()) if iters.size else 0.0
+
+    @property
+    def iterations_max(self) -> int:
+        _, iters = self._materialize()
+        return int(iters.max()) if iters.size else 0
+
+    @property
+    def num_entities(self) -> int:
+        _, iters = self._materialize()
+        return int(iters.size)
 
 
 def _onehot(slot: Array, dim: int, dtype) -> Array:
@@ -586,16 +641,11 @@ class RandomEffectCoordinate:
             variances=v_all,
             entity_keys=ds.entity_keys,
         )
-        if reasons:
-            all_reasons = np.asarray(
-                jnp.concatenate([r for r, _ in reasons]))
-            all_iters = np.asarray(jnp.concatenate(iters))
-            keep = np.concatenate([real for _, real in reasons])
-            stats = RandomEffectTrainingStats.from_arrays(
-                all_reasons[keep], all_iters[keep])
-        else:
-            stats = RandomEffectTrainingStats.from_arrays(
-                np.empty(0, np.int32), np.empty(0, np.int32))
+        # Diagnostics stay on device: the CD loop never reads them, and an
+        # eager fetch here would sync the host to every block solve.
+        stats = RandomEffectTrainingStats.from_device(
+            [r for r, _ in reasons], iters, [real for _, real in reasons]
+        )
         return model, stats
 
     def score(self, model: RandomEffectModel) -> Array:

@@ -11,8 +11,11 @@ so there is one in-memory implementation with save/load.
 
 from __future__ import annotations
 
+import hashlib
 import json
 from pathlib import Path
+
+import numpy as np
 
 from photon_tpu.types import INTERCEPT_KEY, FeatureKey
 
@@ -119,18 +122,12 @@ class HashedIndexMap:
 
     @staticmethod
     def _hash(key: str):
-        import hashlib
-
-        import numpy as np
-
         return np.uint64(int.from_bytes(
             hashlib.blake2b(key.encode(), digest_size=8).digest(), "little"
         ))
 
     @staticmethod
     def from_feature_names(names, *, add_intercept: bool = True):
-        import numpy as np
-
         uniq = sorted(set(str(n) for n in names) - {INTERCEPT_KEY})
         if add_intercept:
             uniq.append(INTERCEPT_KEY)
@@ -159,17 +156,16 @@ class HashedIndexMap:
         return bytes(self._blob[lo:hi]).decode()
 
     def get_index(self, name: FeatureKey) -> int | None:
-        import numpy as np
-
         if self._hashes.size == 0:
             return None
-        h = self._hash(str(name))
+        key = str(name)
+        h = self._hash(key)
         pos = int(np.searchsorted(self._hashes, h))
         if pos >= self._hashes.size or self._hashes[pos] != h:
             return None
         # Exact verification against the blob: a probe key that collides
         # with a stored hash must not resolve to the stored key's index.
-        if self._name_at_pos(pos) != str(name):
+        if self._name_at_pos(pos) != key:
             return None
         return int(self._indices[pos])
 
@@ -199,8 +195,6 @@ class HashedIndexMap:
     # -- persistence ---------------------------------------------------------
 
     def save(self, path: str | Path) -> None:
-        import numpy as np
-
         # Write through a file object so the archive lands at EXACTLY the
         # given path (np.savez_compressed on a string appends ".npz",
         # silently breaking the save/load round trip for other suffixes).
@@ -216,8 +210,6 @@ class HashedIndexMap:
 
     @staticmethod
     def load(path: str | Path) -> "HashedIndexMap":
-        import numpy as np
-
         with np.load(str(path)) as z:
             return HashedIndexMap(
                 z["hashes"], z["indices"], z["pos_by_index"],

@@ -42,6 +42,18 @@ class CoordinateUpdateEvent(PhotonEvent):
     def coordinate_id(self) -> str:
         return self.record.coordinate_id
 
+    @property
+    def seconds(self) -> float:
+        return self.record.seconds
+
+    @property
+    def diagnostics(self):
+        return self.record.diagnostics
+
+    @property
+    def evaluation(self):
+        return self.record.evaluation
+
 
 @dataclasses.dataclass(frozen=True)
 class FitEndEvent(PhotonEvent):

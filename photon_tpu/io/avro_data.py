@@ -79,9 +79,10 @@ class _EllBuilder:
     the end. Peak memory = the final arrays + one chunk of Python rows —
     never a whole-dataset list of per-row tuples."""
 
-    def __init__(self, dtype=np.float32):
+    def __init__(self, num_features: int, dtype=np.float32):
         self.chunks: list[tuple[np.ndarray, np.ndarray]] = []
         self.k = 1
+        self.num_features = num_features
         self.dtype = dtype
 
     def add_chunk(self, rows: list) -> None:
@@ -95,6 +96,14 @@ class _EllBuilder:
             for j, (fi, fv) in enumerate(row):
                 idx[i, j] = fi
                 val[i, j] = fv
+        # Range check (rows_to_ell's guard): a non-contiguous index map
+        # must raise here, not silently clamp inside the device gather.
+        if idx.size and (int(idx.max()) >= self.num_features
+                         or int(idx.min()) < 0):
+            raise ValueError(
+                f"feature index out of range [0, {self.num_features}): "
+                f"min {int(idx.min())}, max {int(idx.max())}"
+            )
         self.chunks.append((idx, val))
 
     def finish(self) -> tuple[np.ndarray, np.ndarray]:
@@ -205,8 +214,6 @@ def read_merged(
         for shard in missing_maps:
             out_maps[shard] = IndexMap.from_feature_names(
                 keysets.pop(shard), add_intercept=shard_intercept(shard))
-    elif id_tag_names == "auto":
-        id_tag_names = []
 
     id_columns = list(id_columns or ())
     overlap = set(id_columns) & set(id_tag_names or ())
@@ -220,7 +227,9 @@ def read_merged(
     offsets_chunks: list[np.ndarray] = []
     weights_chunks: list[np.ndarray] = []
     uids_chunks: list[np.ndarray] = []
-    builders = {s: _EllBuilder(np_dtype) for s in feature_shards}
+    builders = {
+        s: _EllBuilder(len(out_maps[s]), np_dtype) for s in feature_shards
+    }
     tag_names = list(id_columns)
     for t in id_tag_names or ():
         if t not in tag_names:
