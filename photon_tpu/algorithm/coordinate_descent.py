@@ -50,11 +50,18 @@ class ValidationContext:
 @dataclasses.dataclass(frozen=True)
 class CoordinateUpdateRecord:
     """One coordinate update's diagnostics (OptimizationStatesTracker /
-    RandomEffectOptimizationTracker equivalents plus timing)."""
+    RandomEffectOptimizationTracker equivalents plus timing).
+
+    ``seconds`` is host DISPATCH time: training is fully asynchronous (no
+    host sync per update), so device execution overlaps later updates and
+    is not attributable per coordinate. End-to-end wall time lives at the
+    fit / driver level, where the caller's first blocking read (evaluation,
+    model save) absorbs the queued work.
+    """
 
     iteration: int
     coordinate_id: str
-    seconds: float
+    seconds: float  # host dispatch time (see class docstring)
     diagnostics: Any
     evaluation: EvaluationResults | None
 
@@ -218,7 +225,7 @@ class CoordinateDescent:
                     )
                 else:
                     logger.info(
-                        "CD iter %d coordinate %s trained (%.2fs)",
+                        "CD iter %d coordinate %s dispatched (%.2fs)",
                         it, cid, seconds,
                     )
                 record = CoordinateUpdateRecord(

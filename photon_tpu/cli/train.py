@@ -66,6 +66,7 @@ def _run(args) -> int:
         build_normalization_context,
     )
     from photon_tpu.stat import FeatureDataStatistics
+    from photon_tpu.types import TaskType
     from photon_tpu.utils import Timed, profile_trace
 
     t_start = time.time()
@@ -79,14 +80,23 @@ def _run(args) -> int:
         """libsvm -> single-shard GameDataset + identity index map."""
         from photon_tpu.data.game_data import make_game_dataset
 
+        # -1/+1 -> 0/1 label mapping is a BINARY convention; regression
+        # labels legitimately go negative and must pass through.
+        binary = cfg.task in (
+            TaskType.LOGISTIC_REGRESSION,
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        )
         if index_map is None:
-            batch = read_libsvm(path)
+            batch = read_libsvm(path, binary_labels_to01=binary)
             imap = IndexMap.identity(
                 batch.num_features - 1, add_intercept=True
             )
         else:
             imap = index_map
-            batch = read_libsvm(path, num_features=len(imap) - 1)
+            batch = read_libsvm(
+                path, num_features=len(imap) - 1,
+                binary_labels_to01=binary,
+            )
         game = make_game_dataset(
             batch.labels,
             {"features": batch.features},

@@ -1,0 +1,128 @@
+"""``photon glm``: the legacy single-GLM lambda-sweep driver.
+
+Reference: Driver.scala:60 (stages), ModelTraining.scala:100 (warm-started
+sweep), Evaluation.scala:31-110 (legacy metric map), ModelSelection.scala
+(per-task best-lambda selection).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.cli.glm import main as glm_main
+from photon_tpu.io.avro_data import write_training_examples
+from photon_tpu.types import DELIMITER
+
+
+@pytest.fixture
+def binary_avro(tmp_path, rng):
+    n, d = 1200, 6
+    keys = [f"f{i}{DELIMITER}t" for i in range(d)]
+    w = rng.normal(size=d)
+
+    def write(path, n_rows, seed):
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(n_rows, d))
+        z = x @ w
+        y = (r.uniform(size=n_rows) < 1 / (1 + np.exp(-z))).astype(float)
+        rows = [
+            [(keys[j], float(x[i, j])) for j in range(d)]
+            for i in range(n_rows)
+        ]
+        write_training_examples(str(path), y, rows, uids=np.arange(n_rows))
+
+    train, val = tmp_path / "train.avro", tmp_path / "val.avro"
+    write(train, n, 1)
+    write(val, 400, 2)
+    return train, val
+
+
+def test_logistic_sweep_selects_by_auc(tmp_path, binary_avro):
+    train, val = binary_avro
+    out = tmp_path / "out"
+    assert glm_main([
+        "--train", str(train), "--validate", str(val),
+        "--task", "LOGISTIC_REGRESSION", "--output-dir", str(out),
+        "--lambdas", "100,1,0.01",
+    ]) == 0
+    summary = json.loads((out / "glm-summary.json").read_text())
+    assert summary["stages"] == ["PREPROCESSED", "TRAINED", "VALIDATED"]
+    assert summary["lambdas"] == [100.0, 1.0, 0.01]  # descending sweep
+    metrics = summary["metrics"]
+    assert set(metrics) == {"100.0", "1.0", "0.01"}
+    # Legacy binary metric family present.
+    assert {"AUC", "AUPR", "PEAK_F1", "F1=0.5"} <= set(metrics["1.0"])
+    # Selection = argmax AUC (ModelSelection.selectBestLinearClassifier).
+    # (AUC is near-invariant to uniform L2 shrinkage, so any lambda may
+    # legitimately win; the contract is consistency with the metric map.)
+    best = max(metrics, key=lambda k: metrics[k]["AUC"])
+    assert summary["best_lambda"] == float(best)
+    # Per-lambda models + the selected one on disk, loadable.
+    assert (out / "models" / "lambda=100" / "model-metadata.json").is_file()
+    from photon_tpu.cli.index import load_index_maps  # noqa: F401
+    from photon_tpu.io.model_io import load_game_model
+    from photon_tpu.io.avro_data import read_training_examples
+
+    _, imap = read_training_examples(str(train))
+    model, _ = load_game_model(str(out / "best-model"), {"features": imap})
+    assert "global" in model
+
+
+def test_linear_sweep_selects_by_rmse_and_warm_start(tmp_path, rng):
+    n, d = 800, 5
+    keys = [f"f{i}{DELIMITER}t" for i in range(d)]
+    w = rng.normal(size=d)
+    x = rng.normal(size=(n, d))
+    y = x @ w + 0.05 * rng.normal(size=n)
+    rows = [
+        [(keys[j], float(x[i, j])) for j in range(d)] for i in range(n)
+    ]
+    train = tmp_path / "t.avro"
+    write_training_examples(str(train), y, rows, uids=np.arange(n))
+    out = tmp_path / "out"
+    assert glm_main([
+        "--train", str(train), "--validate", str(train),
+        "--task", "LINEAR_REGRESSION", "--output-dir", str(out),
+        "--lambdas", "1000,0.001", "--model-output-mode", "BEST",
+    ]) == 0
+    summary = json.loads((out / "glm-summary.json").read_text())
+    metrics = summary["metrics"]
+    best = min(metrics, key=lambda k: metrics[k]["RMSE"])
+    assert summary["best_lambda"] == float(best) == 0.001
+    assert {"MAE", "MSE", "RMSE"} <= set(metrics["0.001"])
+    # BEST mode writes only the selected model.
+    assert (out / "best-model" / "model-metadata.json").is_file()
+    assert not (out / "models").exists()
+
+
+def test_libsvm_with_bounds_and_summarization(tmp_path, rng):
+    """libsvm input + coefficient bounds (the legacy constraintMap path,
+    solved by the bound-constrained L-BFGS) + summarization stage."""
+    from photon_tpu.io.model_io import load_game_model
+
+    n, d = 400, 4
+    x = rng.normal(size=(n, d))
+    w = np.array([2.0, -2.0, 0.5, 0.1])
+    y = x @ w + 0.05 * rng.normal(size=n)
+    libsvm = tmp_path / "train.txt"
+    with open(libsvm, "w") as f:
+        for i in range(n):
+            feats = " ".join(f"{j+1}:{x[i, j]:.6f}" for j in range(d))
+            f.write(f"{y[i]:.6f} {feats}\n")
+    out = tmp_path / "out"
+    assert glm_main([
+        "--train", str(libsvm), "--validate", str(libsvm),
+        "--format", "libsvm",
+        "--task", "LINEAR_REGRESSION", "--output-dir", str(out),
+        "--lambdas", "0.01", "--coefficient-bounds=-1,1",
+    ]) == 0
+    # Bounds clamp the +-2 generating weights to the box.
+    from photon_tpu.data.index_map import IndexMap
+
+    imap = IndexMap.identity(d, add_intercept=True)
+    model, _ = load_game_model(str(out / "best-model"), {"features": imap})
+    means = np.asarray(model["global"].model.coefficients.means)
+    assert means.max() <= 1.0 + 1e-6 and means.min() >= -1.0 - 1e-6
+    assert np.abs(means).max() > 0.9  # actually pushed to the bound
