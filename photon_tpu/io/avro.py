@@ -341,6 +341,56 @@ def write_container(
         flush()
 
 
+_PROGRAM_OPS = {
+    "null": 0, "boolean": 1, "int": 2, "long": 2,
+    "float": 3, "double": 4, "string": 5, "bytes": 6,
+}
+
+
+def schema_to_program(node, _stack=None):
+    """Compile a parsed schema node into the native decoder's opcode tree
+    (photon_tpu/native/avrodec.c documents the encoding). Returns None for
+    shapes the native decoder does not handle (recursive types) — callers
+    fall back to the interpreter codec."""
+    if isinstance(node, str):
+        return (_PROGRAM_OPS[node],)
+    if isinstance(node, list):
+        branches = tuple(
+            schema_to_program(b, _stack) for b in node
+        )
+        if any(b is None for b in branches):
+            return None
+        return (10, branches)
+    stack = _stack if _stack is not None else set()
+    key = id(node)
+    if key in stack:
+        return None  # recursive type: interpreter fallback
+    stack.add(key)
+    try:
+        t = node["type"]
+        if t == "record":
+            names = tuple(f["name"] for f in node["fields"])
+            progs = tuple(
+                schema_to_program(f["type"], stack) for f in node["fields"]
+            )
+            if any(p is None for p in progs):
+                return None
+            return (7, names, progs)
+        if t == "array":
+            item = schema_to_program(node["items"], stack)
+            return None if item is None else (8, item)
+        if t == "map":
+            val = schema_to_program(node["values"], stack)
+            return None if val is None else (9, val)
+        if t == "enum":
+            return (11, tuple(node["symbols"]))
+        if t == "fixed":
+            return (12, int(node["size"]))
+        return None
+    finally:
+        stack.discard(key)
+
+
 def iter_container(path: str):
     """Stream an Avro object container file block by block.
 
@@ -348,7 +398,13 @@ def iter_container(path: str):
     (``sync_interval`` records, default 4000) of Python dicts is alive —
     the O(batch) decode the ingest pipeline builds its arrays from. The
     file handle closes when the generator is exhausted or dropped.
+
+    Blocks decode through the native C decoder when it is available
+    (photon_tpu/native, ~40x the interpreter codec); the interpreter path
+    remains the behavioral reference and the fallback.
     """
+    from photon_tpu.native import get_avro_decoder
+
     with open(path, "rb") as f:
         if f.read(4) != MAGIC:
             raise ValueError(f"{path}: not an Avro container file")
@@ -357,6 +413,8 @@ def iter_container(path: str):
         codec = meta.get("avro.codec", b"null").decode()
         sync = f.read(SYNC_SIZE)
         schema = Schema(schema_json)
+        program = schema_to_program(schema.root)
+        native = get_avro_decoder() if program is not None else None
         while True:
             try:
                 count = _read_long(f)
@@ -368,9 +426,12 @@ def iter_container(path: str):
                 data = zlib.decompress(data, wbits=-15)
             elif codec != "null":
                 raise ValueError(f"unsupported codec {codec!r}")
-            block = io.BytesIO(data)
-            for _ in range(count):
-                yield _decode(block, schema.root)
+            if native is not None:
+                yield from native.decode_block(data, count, program)
+            else:
+                block = io.BytesIO(data)
+                for _ in range(count):
+                    yield _decode(block, schema.root)
             marker = f.read(SYNC_SIZE)
             if marker != sync:
                 raise ValueError(f"{path}: sync marker mismatch")

@@ -1,0 +1,88 @@
+"""Native runtime components, built lazily with the system toolchain.
+
+The compute path is JAX/XLA; the HOST runtime around it (here: the Avro
+block decoder feeding ingest) is native C, mirroring how the reference
+leans on the JVM Avro runtime's generated decoders (AvroUtils.scala:62)
+rather than interpreting schemas per record.
+
+``get_avro_decoder()`` compiles ``avrodec.c`` into a per-user cache
+directory on first use (source-hash keyed, so edits rebuild) and returns
+the extension module, or None when no working compiler is available —
+callers fall back to the interpreter codec, so the native layer is a pure
+accelerator, never a dependency.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import logging
+import os
+import subprocess
+import sysconfig
+
+logger = logging.getLogger(__name__)
+
+_SOURCE = os.path.join(os.path.dirname(__file__), "avrodec.c")
+_cached = None
+_failed = False
+
+
+def _cache_dir() -> str:
+    base = os.environ.get(
+        "PHOTON_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "photon_tpu_native"),
+    )
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _build() -> str | None:
+    with open(_SOURCE, "rb") as f:
+        src = f.read()
+    tag = hashlib.blake2b(
+        src + sysconfig.get_config_var("EXT_SUFFIX").encode(),
+        digest_size=8,
+    ).hexdigest()
+    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(_cache_dir(), f"photon_avrodec_{tag}{ext}")
+    if os.path.exists(out):
+        return out
+    include = sysconfig.get_paths()["include"]
+    cc = os.environ.get("CC", "cc")
+    tmp = out + ".tmp"
+    cmd = [cc, "-O2", "-fPIC", "-shared", f"-I{include}", _SOURCE, "-o", tmp]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", b"") or b""
+        logger.info(
+            "native avro decoder unavailable (%s: %s); falling back to the "
+            "interpreter codec", e, detail.decode(errors="replace")[:500],
+        )
+        return None
+    os.replace(tmp, out)
+    return out
+
+
+def get_avro_decoder():
+    """The compiled ``photon_avrodec`` module, or None (fallback)."""
+    global _cached, _failed
+    if _cached is not None or _failed:
+        return _cached
+    try:
+        path = _build()
+        if path is None:
+            _failed = True
+            return None
+        spec = importlib.util.spec_from_file_location("photon_avrodec", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _cached = mod
+    except Exception as e:  # any load failure -> interpreter fallback
+        logger.info("native avro decoder failed to load (%s)", e)
+        _failed = True
+        return None
+    return _cached
