@@ -94,8 +94,28 @@ class FixedEffectCoordinate:
             batch = downsample(
                 batch, rate, jax.random.key(seed), binary=binary)
         initial = initial_model.coefficients if initial_model is not None else None
+        if initial is not None:
+            # Column-sharded features solve in a device-count-padded
+            # coefficient space; externally visible models stay at the
+            # logical feature count (see the trim below).
+            initial = initial.padded_to(batch.num_features)
         solution = self.problem.run(batch, initial)
-        return solution.model, solution.result
+        model = solution.model
+        logical_d = getattr(batch.features, "logical_d", None)
+        if logical_d is not None and logical_d != batch.num_features:
+            coefs = model.coefficients
+            model = dataclasses.replace(
+                model,
+                coefficients=dataclasses.replace(
+                    coefs,
+                    means=coefs.means[:logical_d],
+                    variances=(
+                        None if coefs.variances is None
+                        else coefs.variances[:logical_d]
+                    ),
+                ),
+            )
+        return model, solution.result
 
     def score(self, model: GeneralizedLinearModel) -> Array:
         s = model.coefficients.compute_score(self.batch.features)

@@ -34,6 +34,25 @@ Array = jax.Array
 logger = logging.getLogger(__name__)
 
 
+def _serialize_on_cpu_mesh(x) -> None:
+    """Block on ``x`` when it lives on a multi-device CPU mesh.
+
+    XLA's CPU in-process communicator can deadlock when two
+    collective-bearing executions are in flight at once (their all-reduce
+    rendezvous interleave across the shared device threads). TPU streams
+    execute programs in dispatch order per device, so the async pipeline is
+    safe on hardware — but the forced-host-device mesh (tests, the driver's
+    multichip dryrun) must serialize, and one host sync per coordinate
+    update is noise next to the solve it waits on.
+    """
+    devices = getattr(x, "devices", None)
+    if devices is None:
+        return
+    ds = x.devices()
+    if len(ds) > 1 and next(iter(ds)).platform == "cpu":
+        jax.block_until_ready(x)
+
+
 @dataclasses.dataclass(frozen=True)
 class ValidationContext:
     """Validation data + per-coordinate scorers.
@@ -149,6 +168,7 @@ class CoordinateDescent:
             if cid in initial_models:
                 models[cid] = initial_models[cid]
                 s = coordinates[cid].score(models[cid])
+                _serialize_on_cpu_mesh(s)
                 scores[cid] = s
                 total = add(total, s)
 
@@ -176,6 +196,7 @@ class CoordinateDescent:
                     seed=seed + it,
                 )
                 new_scores = coord.score(model)
+                _serialize_on_cpu_mesh(new_scores)
                 # summedScores - oldScores + previousScores (:442,583)
                 if total is None:
                     total = new_scores

@@ -217,9 +217,12 @@ class GLMOptimizationProblem:
                 raise ValueError(
                     "incremental training requires prior variances "
                     "(GameEstimator.scala:241-382 invariants)")
+            # padded_to covers column-sharded solves: pad-slot variance 0 is
+            # the "absent from prior" marker (inverse_prior_variances).
+            p = self.prior.padded_to(d)
             prior = (
-                jnp.asarray(self.prior.means, dtype=dtype),
-                jnp.asarray(self.prior.variances, dtype=dtype),
+                jnp.asarray(p.means, dtype=dtype),
+                jnp.asarray(p.variances, dtype=dtype),
             )
         # Box-constraint arrays make the optimizer config unhashable; that
         # rare path runs untraced (the constraints become trace constants).

@@ -106,7 +106,11 @@ def parse_coordinate(cid: str, d: dict) -> CoordinateSpec:
     shard = d.get("feature_shard", "features")
     kind = d.get("type", "fixed").lower()
     if kind in ("fixed", "fixed_effect", "fixed-effect"):
-        cfg = FixedEffectCoordinateConfiguration(shard, opt_cfg)
+        cfg = FixedEffectCoordinateConfiguration(
+            shard, opt_cfg,
+            feature_sharding=str(
+                d.get("feature_sharding", "replicated")).lower(),
+        )
     elif kind in ("random", "random_effect", "random-effect"):
         cfg = RandomEffectCoordinateConfiguration(
             RandomEffectDataConfiguration(

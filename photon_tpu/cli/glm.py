@@ -203,10 +203,20 @@ def _run(args, log, t_start) -> int:
         box = (jnp.full(d, lo, train_batch.labels.dtype),
                jnp.full(d, hi, train_batch.labels.dtype))
     reg_type = optim.RegularizationType(args.regularization.upper())
+    use_tron = args.optimizer == "TRON"
+    if use_tron and box is not None:
+        # TRON handles the box by projecting after each accepted step,
+        # which can terminate at non-KKT points on bound-active problems;
+        # the gradient-projection LBFGSB solver is the correct tool, so
+        # bounded configs are routed there regardless of --optimizer.
+        log.warning(
+            "--coefficient-bounds with --optimizer TRON: routing to the "
+            "bound-constrained L-BFGS-B solver (TRON's projection-after-"
+            "step semantics can stall at non-KKT points)")
+        use_tron = False
     opt_cfg = (
-        optim.OptimizerConfig.tron(
-            max_iterations=args.max_iterations, box_constraints=box)
-        if args.optimizer == "TRON"
+        optim.OptimizerConfig.tron(max_iterations=args.max_iterations)
+        if use_tron
         else optim.OptimizerConfig.lbfgs(
             tolerance=args.tolerance, max_iterations=args.max_iterations,
             box_constraints=box)

@@ -71,14 +71,44 @@ _DEFAULT_EVALUATOR = {
 }
 
 
+# Feature count above which "auto" feature sharding goes column-wise — the
+# reference's own threshold for switching to off-heap PalDB indexes
+# (index/FeatureIndexingDriver.scala:40-41 recommends them >200k features).
+AUTO_COLUMN_SHARDING_THRESHOLD = 200_000
+
+
 @dataclasses.dataclass(frozen=True)
 class FixedEffectCoordinateConfiguration:
-    """Reference: FixedEffectDataConfiguration + its optimization config."""
+    """Reference: FixedEffectDataConfiguration + its optimization config.
+
+    ``feature_sharding`` picks the coefficient placement on a mesh:
+
+    - ``"replicated"`` (default): coefficients replicated per device, batch
+      rows sharded (dp) — right for d that fits every chip's HBM.
+    - ``"column"``: the FEATURE axis is sharded (tp): each device owns a
+      contiguous coefficient range and the ELL entries whose feature falls
+      in it; margins psum over ICI, gradient scatters stay device-local
+      (parallel/mesh.py FeatureShardedSparse). This is the product path for
+      the reference's "hundreds of billions of coefficients" axis
+      (README.md:56, carried there by PalDB off-heap indexes,
+      index/PalDBIndexMap.scala:43 + sparse vectors).
+    - ``"auto"``: column when a mesh is active and the shard's feature count
+      exceeds AUTO_COLUMN_SHARDING_THRESHOLD, else replicated.
+
+    Without a mesh every mode degrades to the single-device replicated path.
+    """
 
     feature_shard_id: str
     optimization: GLMOptimizationConfiguration = dataclasses.field(
         default_factory=GLMOptimizationConfiguration
     )
+    feature_sharding: str = "replicated"
+
+    def __post_init__(self):
+        if self.feature_sharding not in ("replicated", "column", "auto"):
+            raise ValueError(
+                f"feature_sharding must be 'replicated', 'column' or "
+                f"'auto', got {self.feature_sharding!r}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -245,6 +275,13 @@ class GameEstimator:
                     ds = shard_random_effect_dataset(ds, mesh)
                 out[cid] = ds
             else:
+                if mesh is not None and self._wants_column_sharding(
+                    data, cfg
+                ):
+                    out[cid] = self._build_column_sharded_batch(
+                        data, cfg, mesh
+                    )
+                    continue
                 batch = data.shard_batch(cfg.feature_shard_id)
                 if mesh is not None:
                     if isinstance(batch.features, DualEllFeatures):
@@ -255,6 +292,74 @@ class GameEstimator:
                         batch = shard_batch(batch, mesh)
                 out[cid] = batch
         return out
+
+    def _wants_column_sharding(
+        self, data: GameDataset, cfg: FixedEffectCoordinateConfiguration
+    ) -> bool:
+        mode = cfg.feature_sharding
+        if mode == "column":
+            return True
+        if mode == "auto":
+            feats = data.feature_shards[cfg.feature_shard_id]
+            if feats.num_features <= AUTO_COLUMN_SHARDING_THRESHOLD:
+                return False
+            # The auto heuristic degrades to replicated on shards the
+            # column path can't take (explicit "column" hard-fails instead).
+            why = self._column_sharding_blocker(data, cfg.feature_shard_id)
+            if why is not None:
+                logger.info(
+                    "shard %s: auto feature sharding staying replicated "
+                    "(%s)", cfg.feature_shard_id, why)
+                return False
+            return True
+        return False
+
+    def _column_sharding_blocker(
+        self, data: GameDataset, shard: str
+    ) -> str | None:
+        """Why ``shard`` can't go column-sharded, or None if it can."""
+        norm = self.normalization.get(shard)
+        if norm is not None and not norm.is_identity:
+            return "feature normalization is active"
+        if data.host_shard_tail(shard) is not None:
+            return "DualEll overflow tail present"
+        return None
+
+    def _build_column_sharded_batch(
+        self, data: GameDataset, cfg, mesh
+    ):
+        """Feature-axis-sharded (tp) fixed-effect batch.
+
+        Coefficients and ELL feature entries are split by feature range over
+        the mesh; rows stay at canonical length with labels/offsets/weights
+        replicated, so residual routing needs no padding bookkeeping.
+        """
+        from photon_tpu.data.dataset import GLMBatch
+        from photon_tpu.parallel.mesh import (
+            replicated,
+            shard_features_by_column,
+        )
+
+        shard = cfg.feature_shard_id
+        why = self._column_sharding_blocker(data, shard)
+        if why is not None:
+            raise ValueError(
+                f"coordinate shard {shard!r}: column feature sharding is "
+                f"unsupported here ({why}); normalize at ingest / raise the "
+                "DualEll slab width cap, or use replicated sharding")
+        idx, val, d = data.host_shard_coo(shard)
+        feats = shard_features_by_column(
+            idx, val, d, mesh,
+            axis_name=mesh.axis_names[0],
+            dtype=data.labels.dtype,
+        )
+        rep = replicated(mesh)
+        return GLMBatch(
+            features=feats,
+            labels=jax.device_put(data.labels, rep),
+            offsets=jax.device_put(data.offsets, rep),
+            weights=jax.device_put(data.weights, rep),
+        )
 
     def _build_coordinates(
         self,

@@ -415,6 +415,13 @@ class EvaluatorSpec:
         if "=" in spec:
             head, t = spec.split("=", 1)
             head = head.strip().upper()
+            if ":" in t:
+                raise ValueError(
+                    f"threshold metrics do not support group tags "
+                    f"(got {spec!r}); the reference's per-group evaluation "
+                    f"covers AUC and precision@k only "
+                    f"(MultiEvaluatorType.scala:52-66)"
+                )
             if head not in THRESHOLD_METRICS:
                 raise ValueError(
                     f"unknown threshold metric {head!r}; expected one of "

@@ -52,6 +52,25 @@ class Coefficients:
     def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
         return Coefficients(means=jnp.zeros(dim, dtype=dtype))
 
+    def padded_to(self, dim: int) -> "Coefficients":
+        """Zero-pad means (and variances, if present) up to ``dim``.
+
+        The bridge from logical-d models into a column-sharded solve's
+        device-count-padded coefficient space: zero means are inert as a
+        warm start, and zero variances mark "absent from the prior" for
+        ``inverse_prior_variances``'s l2 fallback.
+        """
+        pad = dim - self.means.shape[-1]
+        if pad <= 0:
+            return self
+        return Coefficients(
+            means=jnp.pad(self.means, (0, pad)),
+            variances=(
+                None if self.variances is None
+                else jnp.pad(self.variances, (0, pad))
+            ),
+        )
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)

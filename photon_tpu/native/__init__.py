@@ -50,7 +50,7 @@ def _build() -> str | None:
         return out
     include = sysconfig.get_paths()["include"]
     cc = os.environ.get("CC", "cc")
-    tmp = out + ".tmp"
+    tmp = f"{out}.{os.getpid()}.tmp"
     cmd = [cc, "-O2", "-fPIC", "-shared", f"-I{include}", _SOURCE, "-o", tmp]
     try:
         subprocess.run(
@@ -62,6 +62,12 @@ def _build() -> str | None:
             "native avro decoder unavailable (%s: %s); falling back to the "
             "interpreter codec", e, detail.decode(errors="replace")[:500],
         )
+        # A failed compile can leave a partial object behind; the tmp name
+        # is per-pid, so stragglers would accumulate in the shared cache.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
     os.replace(tmp, out)
     return out
@@ -72,6 +78,7 @@ def get_avro_decoder():
     global _cached, _failed
     if _cached is not None or _failed:
         return _cached
+    path = None
     try:
         path = _build()
         if path is None:
@@ -83,6 +90,13 @@ def get_avro_decoder():
         _cached = mod
     except Exception as e:  # any load failure -> interpreter fallback
         logger.info("native avro decoder failed to load (%s)", e)
+        # A corrupted cache file would otherwise poison every later
+        # process; drop it so the next call rebuilds from source.
+        try:
+            if path is not None:
+                os.unlink(path)
+        except OSError:
+            pass
         _failed = True
         return None
     return _cached

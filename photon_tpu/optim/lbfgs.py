@@ -162,8 +162,16 @@ def _wolfe_line_search(
         shrink = (~armijo) | (s.bracketed & (f_t >= s.f_lo))
         # Case 2: both conditions hold — accept.
         accept = armijo & curv
-        # Case 3: Armijo holds but the slope is still too negative/positive.
-        pos_slope = armijo & (~curv) & (dphi >= 0)
+        # Case 3: Armijo holds but curvature fails. Inside the zoom the
+        # bracket may be stored reversed (t_lo > t_hi after a flip), so the
+        # end-replacement test must be the SIGNED slope relative to the
+        # bracket direction (N&W zoom: dphi*(t_hi - t_lo) >= 0 flips
+        # t_hi := t_lo); unbracketed expansion moves in increasing t where
+        # the plain dphi >= 0 test applies.
+        flip = jnp.where(
+            s.bracketed, dphi * (s.t_hi - s.t_lo) >= 0, dphi >= 0
+        )
+        pos_slope = armijo & (~curv) & flip
 
         bracketed = s.bracketed | shrink | pos_slope
         t_hi = jnp.where(

@@ -275,6 +275,10 @@ class FeatureShardedSparse:
         from jax import shard_map
 
         axis = self.axis
+        if w.shape[0] < self.d:
+            # Trained models are trimmed to logical_d at the coordinate
+            # boundary; re-pad here so scoring accepts them directly.
+            w = jnp.pad(w, (0, self.d - w.shape[0]))
 
         def local(idx, val, w_local):
             z = jnp.sum(val[0] * w_local[idx[0]], axis=-1)
