@@ -346,6 +346,68 @@ class RandomEffectDataset:
     block_intercepts_np: tuple = ()
 
     @property
+    def num_rows(self) -> int:
+        """Canonical row count of the table this dataset was built from."""
+        return int(self.score_codes.shape[0])
+
+    def device_blocks(self) -> tuple:
+        """Training blocks with feature slabs materialized ON DEVICE (cached).
+
+        Lazy ``BlockPlan`` buckets re-gather their [B, R, S] feature slab
+        from the raw arrays on EVERY solve call; the slab is
+        residual-independent, so materializing it once per dataset cuts the
+        per-solve gather traffic to the [B, R] residual rows (~S x less).
+        The one-time cost is HBM for the slabs — gated by
+        ``_DEVICE_SLAB_BUDGET_BYTES``, beyond which the lazy form is kept
+        (gather per solve, bounded memory). Materialization runs as one
+        jitted program per bucket, so slabs never touch the host.
+        """
+        cached = getattr(self, "_device_blocks", None)
+        if cached is not None:
+            return cached
+        out = []
+        spent = 0  # the budget bounds the TOTAL cached bytes, not per block
+        for b in self.blocks:
+            if isinstance(b, BlockPlan):
+                bb, r = b.row_ids.shape
+                s = b.proj.shape[-1]
+                slab_bytes = 4 * bb * r * s
+                if spent + slab_bytes <= _DEVICE_SLAB_BUDGET_BYTES:
+                    spent += slab_bytes
+                    b = _materialize_block_jit(b)
+            out.append(b)
+        out = tuple(out)
+        object.__setattr__(self, "_device_blocks", out)
+        return out
+
+    def covered_row_partition(self):
+        """(covered_mask [n] bool on device, passive_rows host int32 array).
+
+        "Covered" rows appear in some training block (the active kept
+        rows); "passive" rows — beyond the reservoir cap or owned by
+        inactive entities with a trained model — still need scoring
+        (RandomEffectDataset's activeData/passiveData split, :631-640).
+        Cached per dataset.
+        """
+        cached = getattr(self, "_covered", None)
+        if cached is not None:
+            return cached
+        assert self.is_lazy, "row partition is defined for lazy datasets"
+        n = self.num_rows
+        covered = jnp.zeros(n, dtype=bool)
+        for b in self.blocks:
+            # BlockPlan rank-vs-count is exact row validity (a real row
+            # with data weight 0 is still covered and must score).
+            r = b.row_ids.shape[1]
+            valid = jnp.arange(r, dtype=jnp.int32)[None, :] < (
+                b.row_counts[:, None])
+            covered = covered.at[b.row_ids].max(valid)
+        passive = np.nonzero(~np.asarray(covered))[0].astype(np.int32)
+        result = (covered, passive)
+        object.__setattr__(self, "_covered", result)
+        return result
+
+    @property
     def is_lazy(self) -> bool:
         return self.score_indices is None
 
@@ -362,6 +424,17 @@ class RandomEffectDataset:
             int(self.real_entity_mask(i).sum())
             for i in range(len(self.blocks))
         )
+
+
+# Total-HBM budget for cached materialized feature slabs (device_blocks):
+# datasets whose slabs exceed this stay lazy (gather per solve).
+_DEVICE_SLAB_BUDGET_BYTES = 2 << 30
+
+
+@jax.jit
+def _materialize_block_jit(block):
+    """One bucket's residual-independent slabs, gathered on device."""
+    return block.materialize(None)
 
 
 def _stable_type_seed(re_type: str) -> np.uint64:
@@ -613,7 +686,30 @@ def _plan_random_effect(
     proj_mask = keep_sorted & active[sorted_codes]
     rows_p = perm[proj_mask]
     pair_codes = sorted_codes[proj_mask]
-    if rows_p.size:
+    dense_view = isinstance(
+        game_data.feature_shards[config.feature_shard_id], DenseFeatures
+    )
+    if rows_p.size and dense_view and tail is None:
+        # Dense shards: every row touches every column, so the per-entity
+        # active-feature union is a [E, d] presence matrix computed by one
+        # segment-OR over the entity-grouped rows — no 17M-key sort. This
+        # is the hot ingest path for dense GLMix shards (the reference
+        # amortizes the equivalent union across the cluster's foldByKey,
+        # RandomEffectDataset.scala:390-426).
+        present = ell_val[rows_p] != 0.0  # [m, d]; rows grouped by entity
+        m = rows_p.shape[0]
+        seg_starts = np.searchsorted(pair_codes, np.arange(num_entities))
+        seg_ends = np.append(seg_starts[1:], m)
+        presence = np.logical_or.reduceat(
+            present, np.minimum(seg_starts, m - 1), axis=0
+        )
+        # reduceat yields the NEXT segment's first row for empty segments;
+        # entities with no kept active rows have no subspace.
+        presence[seg_starts == seg_ends] = False
+        rows_e, cols_f = np.nonzero(presence)
+        # Row-major nonzero order == ascending key order (stride >= d).
+        uniq = rows_e.astype(np.int64) * np.int64(stride) + cols_f
+    elif rows_p.size:
         iv = ell_idx[rows_p]
         present = ell_val[rows_p] != 0.0
         pair_keys = (
@@ -740,6 +836,52 @@ def _plan_random_effect(
         bucket_members=bucket_members,
         num_features=num_features,
     )
+
+
+# Below this many total bytes the plain batched device_put wins (tiny test
+# datasets skip the splitter compile; its XLA program is trivial but still a
+# per-shape-set compile).
+_PACKED_TRANSFER_MIN_BYTES = 2 << 20
+
+
+def _split_packed_impl(buf, shapes):
+    out = []
+    o = 0
+    for s in shapes:
+        n = int(np.prod(s)) if s else 1
+        out.append(jax.lax.slice_in_dim(buf, o, o + n).reshape(s))
+        o += n
+    return tuple(out)
+
+
+_split_packed = jax.jit(_split_packed_impl, static_argnames=("shapes",))
+
+
+def _plan_arrays_to_device(arrays: list[np.ndarray]):
+    """Push host plan arrays to device, minimizing transfer-path setup.
+
+    Some device links (the dev-tunnel TPU backend here) pay a per-shape
+    first-transfer setup cost (~65ms each); 15+ distinct plan-array shapes
+    made that the dominant ingest cost. Packing everything into ONE int32
+    buffer pays one transfer and one (persistently cached, trivial) split
+    program instead. The buffer length is padded to a power of two so its
+    transfer shape recurs across datasets.
+    """
+    total = sum(a.nbytes for a in arrays)
+    if total < _PACKED_TRANSFER_MIN_BYTES or any(
+        a.dtype != np.int32 for a in arrays
+    ):
+        return jax.device_put(arrays)
+    shapes = tuple(a.shape for a in arrays)
+    n = sum(a.size for a in arrays)
+    n_pad = 1 << max(int(np.ceil(np.log2(max(n, 1)))), 0)
+    flat = np.empty(n_pad, dtype=np.int32)
+    o = 0
+    for a in arrays:
+        flat[o:o + a.size] = a.ravel()
+        o += a.size
+    flat[o:] = 0
+    return list(_split_packed(jax.device_put(flat), shapes=shapes))
 
 
 def _bucket_rows(plan: _Plan, members: np.ndarray, cap: int):
@@ -926,6 +1068,21 @@ def projector_table_from_proj_all(
     return _ProjectorTable(keys, offsets, stride, e)
 
 
+@dataclasses.dataclass
+class PendingRandomEffectDataset:
+    """A lazy-layout build whose device placement is deferred.
+
+    ``flat`` lists the int32 plan arrays awaiting transfer; ``finalize``
+    consumes their device arrays (same order) and returns the dataset. The
+    estimator batches every coordinate's transfer into ONE packed push —
+    one transfer-path setup and one cached split program for the whole fit
+    instead of one per coordinate (`_plan_arrays_to_device`).
+    """
+
+    flat: list
+    finalize: object  # Callable[[list], RandomEffectDataset]
+
+
 def build_random_effect_dataset(
     game_data: GameDataset,
     config: RandomEffectDataConfiguration,
@@ -934,6 +1091,7 @@ def build_random_effect_dataset(
     extra_features: dict[int, np.ndarray] | None = None,
     dtype=None,
     lazy: bool | None = None,
+    defer_transfer: bool = False,
 ) -> RandomEffectDataset:
     """One-shot host-side ingest of a random-effect coordinate's data.
 
@@ -1030,39 +1188,16 @@ def build_random_effect_dataset(
                      bh["intercepts"]]
         proj_dev_np = plan.proj_all.astype(np.int32)
         flat.append(proj_dev_np)
-        devs = jax.device_put(flat)
-        blocks = []
-        for i, bh in enumerate(bucket_host):
-            m, brow, cnt, proj, ints = devs[5 * i:5 * i + 5]
-            blocks.append(BlockPlan(
-                entity_codes=m,
-                row_ids=brow,
-                row_counts=cnt,
-                proj=proj,
-                intercept_slots=ints,
-                raw=feats,
-                raw_labels=game_data.labels,
-                raw_offsets=game_data.offsets,
-                raw_weights=game_data.weights,
-            ))
-        return RandomEffectDataset(
-            config=config,
-            num_entities=num_entities,
-            entity_keys=tag.inverse,
-            blocks=tuple(blocks),
-            max_sub_dim=plan.max_sub_dim,
-            sub_dims=plan.sub_dims,
-            proj_all=plan.proj_all,
-            num_features=plan.num_features,
-            dtype=dtype,
-            score_codes=tag.codes,
-            raw=feats,
-            proj_dev=devs[-1],
-            block_codes_np=tuple(bh["members"] for bh in bucket_host),
-            block_intercepts_np=tuple(
-                bh["intercepts"] for bh in bucket_host
-            ),
-        )
+
+        def finalize(devs):
+            return _finalize_lazy(
+                devs, bucket_host, feats, game_data, config, num_entities,
+                tag, plan, dtype,
+            )
+
+        if defer_transfer:
+            return PendingRandomEffectDataset(flat=flat, finalize=finalize)
+        return finalize(_plan_arrays_to_device(flat))
 
     # ---- materialized layout (DualEll shards, introspection) -------------
     blocks = []
@@ -1139,4 +1274,43 @@ def build_random_effect_dataset(
         score_tail_values=tail_v,
         block_codes_np=tuple(bh["members"] for bh in bucket_host),
         block_intercepts_np=tuple(bh["intercepts"] for bh in bucket_host),
+    )
+
+
+def _finalize_lazy(
+    devs, bucket_host, feats, game_data, config, num_entities, tag, plan,
+    dtype,
+):
+    """Assemble the lazy RandomEffectDataset from placed plan arrays."""
+    blocks = []
+    for i, bh in enumerate(bucket_host):
+        m, brow, cnt, proj, ints = devs[5 * i:5 * i + 5]
+        blocks.append(BlockPlan(
+            entity_codes=m,
+            row_ids=brow,
+            row_counts=cnt,
+            proj=proj,
+            intercept_slots=ints,
+            raw=feats,
+            raw_labels=game_data.labels,
+            raw_offsets=game_data.offsets,
+            raw_weights=game_data.weights,
+        ))
+    return RandomEffectDataset(
+        config=config,
+        num_entities=num_entities,
+        entity_keys=tag.inverse,
+        blocks=tuple(blocks),
+        max_sub_dim=plan.max_sub_dim,
+        sub_dims=plan.sub_dims,
+        proj_all=plan.proj_all,
+        num_features=plan.num_features,
+        dtype=dtype,
+        score_codes=tag.codes,
+        raw=feats,
+        proj_dev=devs[-1],
+        block_codes_np=tuple(bh["members"] for bh in bucket_host),
+        block_intercepts_np=tuple(
+            bh["intercepts"] for bh in bucket_host
+        ),
     )

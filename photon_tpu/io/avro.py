@@ -437,6 +437,128 @@ def iter_container(path: str):
                 raise ValueError(f"{path}: sync marker mismatch")
 
 
+def iter_container_block_bytes(path: str):
+    """Yield (schema_json, count, payload_bytes) per container block.
+
+    ``payload_bytes`` is the decompressed record stream of the block — the
+    concatenated binary encodings of ``count`` records. Golden write-parity
+    tests re-encode decoded records and compare against this byte stream.
+    """
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        meta = _decode(f, _META_SCHEMA)
+        schema_json = json.loads(meta["avro.schema"].decode())
+        codec = meta.get("avro.codec", b"null").decode()
+        sync = f.read(SYNC_SIZE)
+        while True:
+            try:
+                count = _read_long(f)
+            except EOFError:
+                break
+            size = _read_long(f)
+            data = f.read(size)
+            if codec == "deflate":
+                data = zlib.decompress(data, wbits=-15)
+            elif codec != "null":
+                raise ValueError(f"unsupported codec {codec!r}")
+            yield schema_json, count, data
+            if f.read(SYNC_SIZE) != sync:
+                raise ValueError(f"{path}: sync marker mismatch")
+
+
+def encode_records(schema_json: dict, records) -> bytes:
+    """Binary-encode ``records`` under ``schema_json`` (no container
+    framing) — the record-body byte stream a container block holds."""
+    schema = Schema(schema_json)
+    buf = io.BytesIO()
+    for rec in records:
+        _encode(buf, schema.root, rec)
+    return buf.getvalue()
+
+
+# --- Parsing Canonical Form + CRC-64-AVRO fingerprint (Avro spec) --------
+
+_CANONICAL_PRIMITIVES = {
+    "null", "boolean", "int", "long", "float", "double", "bytes", "string",
+}
+
+
+def parsing_canonical_form(schema, namespace: str | None = None) -> str:
+    """The Avro Parsing Canonical Form of a schema (spec section
+    "Transforming into Parsing Canonical Form"): fullnames, attribute
+    stripping ([STRIP] doc/aliases/defaults), fixed field order, minimal
+    JSON. Two schemas with equal canonical form decode identically."""
+    return _pcf(schema, namespace)
+
+
+def _pcf(node, ns):
+    if isinstance(node, str):
+        if node in _CANONICAL_PRIMITIVES:
+            return f'"{node}"'
+        full = node if "." in node or not ns else f"{ns}.{node}"
+        return f'"{full}"'
+    if isinstance(node, list):
+        return "[" + ",".join(_pcf(b, ns) for b in node) + "]"
+    t = node["type"]
+    if isinstance(t, (dict, list)) or (
+        t not in _CANONICAL_PRIMITIVES
+        and t not in ("record", "enum", "array", "map", "fixed")
+    ):
+        # {"type": <nested schema>} wrapper
+        return _pcf(t, ns)
+    if t in _CANONICAL_PRIMITIVES:
+        return f'"{t}"'
+    if t in ("record", "enum", "fixed"):
+        name = node["name"]
+        if "." in name:
+            full = name
+            child_ns = name.rsplit(".", 1)[0]
+        else:
+            child_ns = node.get("namespace", ns)
+            full = f"{child_ns}.{name}" if child_ns else name
+        parts = [f'"name":"{full}"', f'"type":"{t}"']
+        if t == "record":
+            fields = ",".join(
+                "{" + f'"name":"{f["name"]}"'
+                + f',"type":{_pcf(f["type"], child_ns)}' + "}"
+                for f in node["fields"]
+            )
+            parts.append(f'"fields":[{fields}]')
+        elif t == "enum":
+            syms = ",".join(f'"{s}"' for s in node["symbols"])
+            parts.append(f'"symbols":[{syms}]')
+        else:
+            parts.append(f'"size":{int(node["size"])}')
+        return "{" + ",".join(parts) + "}"
+    if t == "array":
+        return '{"type":"array","items":' + _pcf(node["items"], ns) + "}"
+    if t == "map":
+        return '{"type":"map","values":' + _pcf(node["values"], ns) + "}"
+    raise ValueError(f"bad schema node {node!r}")
+
+
+_CRC64_EMPTY = 0xC15D213AA4D7A795
+_crc64_table: list | None = None
+
+
+def schema_fingerprint(schema, namespace: str | None = None) -> int:
+    """CRC-64-AVRO fingerprint of the Parsing Canonical Form (Avro spec)."""
+    global _crc64_table
+    if _crc64_table is None:
+        table = []
+        for i in range(256):
+            fp = i
+            for _ in range(8):
+                fp = (fp >> 1) ^ (_CRC64_EMPTY & -(fp & 1))
+            table.append(fp & 0xFFFFFFFFFFFFFFFF)
+        _crc64_table = table
+    fp = _CRC64_EMPTY
+    for b in parsing_canonical_form(schema, namespace).encode("utf-8"):
+        fp = (fp >> 8) ^ _crc64_table[(fp ^ b) & 0xFF]
+    return fp
+
+
 def iter_container_dir(path: str):
     """Stream all part files of a file-or-directory of Avro containers
     (the HDFS part-* layout of AvroUtils.readAvroFiles)."""

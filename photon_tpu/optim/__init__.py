@@ -18,6 +18,8 @@ from photon_tpu.optim.base import (
     OptimizerType,
     Tolerances,
     ValueAndGrad,
+    absolute_tolerances,
+    convergence_code,
 )
 from photon_tpu.optim.lbfgs import lbfgs_solve
 from photon_tpu.optim.lbfgsb import lbfgsb_solve

@@ -396,3 +396,144 @@ class TestSklearnParityAnchor:
         ours_auc = roc_auc_score(y, X @ w_no_int + w[ii])
         sk_auc = roc_auc_score(y, X @ coef + intercept)
         np.testing.assert_allclose(ours_auc, sk_auc, atol=1e-6)
+
+
+class TestWriteSideParity:
+    """Write-side Avro parity (round-4): nothing here proves the reference
+    JVM can read our files directly (no JVM in this image), so the next
+    best evidence is asserted instead — (a) our writer schemas fingerprint-
+    identically to the reference's .avsc definitions, and (b) our record
+    encoder reproduces Spark-written record-body bytes EXACTLY when
+    re-encoding the reference's own containers (ModelProcessingUtils
+    :77/:143 contract at the byte level, modulo container framing)."""
+
+    SCHEMA_DIR = "/root/reference/photon-avro-schemas/src/main/avro"
+
+    def _ref_schema(self, name):
+        """The reference .avsc, with cross-file named references resolved
+        by inlining each definition at its FIRST depth-first use — the
+        self-contained form Java's Schema.Parser produces and Spark embeds
+        in container files."""
+        import json
+
+        known = {}
+        for f in os.listdir(self.SCHEMA_DIR):
+            if f.endswith(".avsc"):
+                with open(os.path.join(self.SCHEMA_DIR, f)) as fh:
+                    s = json.load(fh)
+                ns = s.get("namespace", "")
+                known[f"{ns}.{s['name']}" if ns else s["name"]] = s
+
+        seen: set = set()
+
+        def resolve(node, ns):
+            if isinstance(node, str):
+                full = node if "." in node else (
+                    f"{ns}.{node}" if ns else node)
+                if full in known:
+                    if full in seen:
+                        return node
+                    seen.add(full)
+                    return resolve(known[full], ns)
+                return node
+            if isinstance(node, list):
+                return [resolve(b, ns) for b in node]
+            node = dict(node)
+            child_ns = node.get("namespace", ns)
+            t = node.get("type")
+            if t == "record":
+                seen.add(
+                    f"{child_ns}.{node['name']}" if child_ns
+                    else node["name"])
+                node["fields"] = [
+                    {**f, "type": resolve(f["type"], child_ns)}
+                    for f in node["fields"]
+                ]
+            elif t == "array":
+                node["items"] = resolve(node["items"], child_ns)
+            elif t == "map":
+                node["values"] = resolve(node["values"], child_ns)
+            elif isinstance(t, (dict, list, str)) and t not in (
+                "enum", "fixed", "null", "boolean", "int", "long",
+                "float", "double", "bytes", "string",
+            ):
+                node["type"] = resolve(t, child_ns)
+            return node
+
+        with open(os.path.join(self.SCHEMA_DIR, name)) as f:
+            root = json.load(f)
+        return resolve(root, root.get("namespace", ""))
+
+    @pytest.mark.parametrize(
+        "ours,ref_file",
+        [
+            ("BAYESIAN_LINEAR_MODEL_SCHEMA", "BayesianLinearModelAvro.avsc"),
+            ("NAME_TERM_VALUE_SCHEMA", "NameTermValueAvro.avsc"),
+            ("SCORING_RESULT_SCHEMA", "ScoringResultAvro.avsc"),
+            (
+                "FEATURE_SUMMARIZATION_SCHEMA",
+                "FeatureSummarizationResultAvro.avsc",
+            ),
+        ],
+    )
+    def test_model_io_schema_fingerprints(self, ours, ref_file):
+        from photon_tpu.io import model_io
+        from photon_tpu.io.avro import schema_fingerprint
+
+        ref = self._ref_schema(ref_file)
+        got = schema_fingerprint(getattr(model_io, ours))
+        want = schema_fingerprint(ref)
+        assert got == want, (
+            f"{ours} drifted from {ref_file}: the reference loader would "
+            "not resolve our records"
+        )
+
+    def test_training_example_schema_fingerprint(self):
+        from photon_tpu.io.avro import schema_fingerprint
+        from photon_tpu.io.avro_data import TRAINING_EXAMPLE_SCHEMA
+
+        got = schema_fingerprint(TRAINING_EXAMPLE_SCHEMA)
+        want = schema_fingerprint(self._ref_schema("TrainingExampleAvro.avsc"))
+        assert got == want
+
+    @pytest.mark.parametrize(
+        "container",
+        [
+            f"{REF}/GameIntegTest/gameModel/fixed-effect/globalShard/"
+            "coefficients/part-00000.avro",
+            YAHOO,
+        ],
+    )
+    def test_reencode_matches_spark_bytes(self, container):
+        """Decode a Spark-written container and re-encode every block with
+        our encoder: the record-body byte streams must be identical. This
+        pins varint/zigzag, union-branch, array-block and string encoding
+        choices to what the JVM writer produces — if our writer drifts,
+        this fails before the reference loader ever could."""
+        import glob as _glob
+
+        from photon_tpu.io.avro import (
+            Schema,
+            _decode,
+            encode_records,
+            iter_container_block_bytes,
+        )
+        import io as _io
+
+        paths = _glob.glob(container) or [container]
+        assert os.path.exists(paths[0]), container
+        blocks = 0
+        for schema_json, count, payload in iter_container_block_bytes(
+            paths[0]
+        ):
+            schema = Schema(schema_json)
+            buf = _io.BytesIO(payload)
+            records = [_decode(buf, schema.root) for _ in range(count)]
+            assert buf.read() == b""  # decoded the whole payload
+            ours = encode_records(schema_json, records)
+            assert ours == payload, (
+                f"re-encoded block {blocks} differs from the Spark-written "
+                "bytes"
+            )
+            blocks += 1
+        assert blocks > 0
