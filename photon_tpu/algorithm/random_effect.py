@@ -402,6 +402,15 @@ def _solve_one_entity_newton(
         w, f, g, it, code = s
         return code == 0
 
+    # All Armijo trial steps evaluate in ONE pass: the margin is affine in
+    # the step size (z_t = z + t * (x @ d)), so a single extra matvec gives
+    # every candidate, replacing up to _NEWTON_LINE_SEARCH_HALVINGS
+    # sequential probe loops with elementwise work — sequential depth is
+    # what the batched solve is bound by.
+    trial_ts = 0.5 ** jnp.arange(
+        _NEWTON_LINE_SEARCH_HALVINGS + 1, dtype=dtype
+    )  # [T]: 1, 1/2, 1/4, ...
+
     def body(s):
         w, f, g, it, code = s
         z = x @ w + offsets
@@ -413,30 +422,22 @@ def _solve_one_entity_newton(
         d = _spd_solve_cg(h, -g, sub_dim) * valid_mask
         gd = jnp.dot(g, d)
 
-        # Armijo backtracking (c1 = 1e-4): halve until sufficient decrease.
-        def ls_cond(ls):
-            t, f_t, halves = ls
-            return (f_t > f + 1e-4 * t * gd) & (
-                halves < _NEWTON_LINE_SEARCH_HALVINGS
-            )
-
-        def ls_body(ls):
-            t, _, halves = ls
-            t_new = t * 0.5
-            z_t = x @ (w + t_new * d) + offsets
-            f_t = jnp.sum(weights * loss.loss(z_t, labels)) + 0.5 * jnp.sum(
-                l2_diag * (w + t_new * d - m_t) ** 2
-            )
-            return t_new, f_t, halves + 1
-
-        z1 = x @ (w + d) + offsets
-        f1 = jnp.sum(weights * loss.loss(z1, labels)) + 0.5 * jnp.sum(
-            l2_diag * (w + d - m_t) ** 2
-        )
-        t, f_t, halves = lax.while_loop(
-            ls_cond, ls_body, (jnp.asarray(1.0, dtype), f1, 0)
-        )
-        improved = f_t < f
+        zd = x @ d  # [R]; z_t = z + t * zd for every trial t
+        z_t = z[None, :] + trial_ts[:, None] * zd[None, :]  # [T, R]
+        w_t_trials = w[None, :] + trial_ts[:, None] * d[None, :]  # [T, S]
+        f_t = jnp.sum(
+            weights[None, :] * loss.loss(z_t, labels[None, :]), axis=1
+        ) + 0.5 * jnp.sum(
+            l2_diag[None, :] * (w_t_trials - m_t[None, :]) ** 2, axis=1
+        )  # [T]
+        armijo = f_t <= f + 1e-4 * trial_ts * gd
+        # First (largest) t satisfying Armijo — the same step sequential
+        # halving would accept.
+        first = jnp.argmax(armijo)
+        any_ok = jnp.any(armijo)
+        t = trial_ts[first]
+        f_t_sel = f_t[first]
+        improved = any_ok & (f_t_sel < f)
         w_new = jnp.where(improved, w + t * d, w)
         f_new, g_new = objective(w_new)
         code_new = optim.convergence_code(

@@ -367,11 +367,23 @@ class RandomEffectDataset:
             return cached
         out = []
         spent = 0  # the budget bounds the TOTAL cached bytes, not per block
+        itemsize = np.dtype(self.dtype).itemsize
         for b in self.blocks:
             if isinstance(b, BlockPlan):
                 bb, r = b.row_ids.shape
                 s = b.proj.shape[-1]
-                slab_bytes = 4 * bb * r * s
+                # Conservative estimate of the materialized layout: the
+                # subspace-dense [B, R, S] slab, or the ELL fallback's
+                # values + int32 slot indices at the raw row width.
+                k_raw = (
+                    b.raw.indices.shape[1]
+                    if isinstance(b.raw, SparseFeatures)
+                    else b.raw.x.shape[1]
+                )
+                slab_bytes = max(
+                    itemsize * bb * r * s,
+                    (itemsize + 4) * bb * r * min(k_raw, s),
+                )
                 if spent + slab_bytes <= _DEVICE_SLAB_BUDGET_BYTES:
                     spent += slab_bytes
                     b = _materialize_block_jit(b)
@@ -700,12 +712,17 @@ def _plan_random_effect(
         m = rows_p.shape[0]
         seg_starts = np.searchsorted(pair_codes, np.arange(num_entities))
         seg_ends = np.append(seg_starts[1:], m)
-        presence = np.logical_or.reduceat(
-            present, np.minimum(seg_starts, m - 1), axis=0
-        )
-        # reduceat yields the NEXT segment's first row for empty segments;
-        # entities with no kept active rows have no subspace.
-        presence[seg_starts == seg_ends] = False
+        nonempty = seg_starts < seg_ends
+        # reduceat over the NONEMPTY starts only: consecutive empty
+        # segments share their successor's start, so a naive clamp of
+        # trailing starts to m-1 would shave the last row off the
+        # preceding entity's union. Nonempty starts partition [0, m)
+        # exactly (each spans to the next nonempty start).
+        presence = np.zeros((num_entities, ell_val.shape[1]), dtype=bool)
+        if nonempty.any():
+            presence[nonempty] = np.logical_or.reduceat(
+                present, seg_starts[nonempty], axis=0
+            )
         rows_e, cols_f = np.nonzero(presence)
         # Row-major nonzero order == ascending key order (stride >= d).
         uniq = rows_e.astype(np.int64) * np.int64(stride) + cols_f

@@ -464,6 +464,14 @@ class GameEstimator:
         first CD sweep compiles serially as before. With fewer than two
         thunks there is no overlap to win and the discarded warm-up solve
         would just double the first fit's work.
+
+        The thunks EXECUTE (one extra discarded solve per program, ~one CD
+        iteration of device work) rather than AOT-compiling via
+        jit(...).lower().compile(): AOT results don't land in the jit
+        dispatch cache, so the real call would re-trace and re-load the
+        executable — and on the tunneled TPU backend the per-program LOAD
+        (not only the compile) is seconds, which executing the thunk pays
+        once and the CD sweep then reuses.
         """
         key = id(datasets)
         if getattr(self, "_primed_datasets", None) == key:

@@ -405,36 +405,18 @@ def iter_container(path: str):
     """
     from photon_tpu.native import get_avro_decoder
 
-    with open(path, "rb") as f:
-        if f.read(4) != MAGIC:
-            raise ValueError(f"{path}: not an Avro container file")
-        meta = _decode(f, _META_SCHEMA)
-        schema_json = json.loads(meta["avro.schema"].decode())
-        codec = meta.get("avro.codec", b"null").decode()
-        sync = f.read(SYNC_SIZE)
-        schema = Schema(schema_json)
-        program = schema_to_program(schema.root)
-        native = get_avro_decoder() if program is not None else None
-        while True:
-            try:
-                count = _read_long(f)
-            except EOFError:
-                break
-            size = _read_long(f)
-            data = f.read(size)
-            if codec == "deflate":
-                data = zlib.decompress(data, wbits=-15)
-            elif codec != "null":
-                raise ValueError(f"unsupported codec {codec!r}")
-            if native is not None:
-                yield from native.decode_block(data, count, program)
-            else:
-                block = io.BytesIO(data)
-                for _ in range(count):
-                    yield _decode(block, schema.root)
-            marker = f.read(SYNC_SIZE)
-            if marker != sync:
-                raise ValueError(f"{path}: sync marker mismatch")
+    schema = program = native = None
+    for schema_json, count, data in iter_container_block_bytes(path):
+        if schema is None:
+            schema = Schema(schema_json)
+            program = schema_to_program(schema.root)
+            native = get_avro_decoder() if program is not None else None
+        if native is not None:
+            yield from native.decode_block(data, count, program)
+        else:
+            block = io.BytesIO(data)
+            for _ in range(count):
+                yield _decode(block, schema.root)
 
 
 def iter_container_block_bytes(path: str):

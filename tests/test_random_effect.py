@@ -455,3 +455,40 @@ def test_direct_solver_skipped_without_l2(rng):
         ds, TaskType.LINEAR_REGRESSION, GLMOptimizationConfiguration())
     model, stats = coord.train()
     assert np.isfinite(np.asarray(model.coefficients)).all()
+
+
+class TestDensePresenceUnion:
+    def test_matches_bruteforce_with_trailing_inactive(self, rng):
+        """The dense-shard segment-OR union must equal the brute-force
+        per-entity nonzero-feature union — including when the highest
+        entity codes have no kept active rows (trailing empty reduceat
+        segments must not shave rows off the preceding entity)."""
+        n, d, E = 61, 5, 9
+        x = rng.normal(size=(n, d))
+        x[np.abs(x) < 0.6] = 0.0  # plenty of exact zeros
+        x[:, -1] = 1.0
+        # Entity E-1 gets exactly ONE row (below the lower bound of 2) and
+        # it is the LAST canonical row, so its empty segment trails.
+        codes = rng.integers(0, E - 1, size=n)
+        codes[-1] = E - 1
+        game = make_game_dataset(
+            x @ np.ones(d),
+            {"shard": DenseFeatures(jnp.asarray(x))},
+            id_tags={"userId": np.asarray([f"u{c:02d}" for c in codes])},
+            dtype=jnp.float64,
+        )
+        cfg = RandomEffectDataConfiguration(
+            "userId", "shard", active_data_lower_bound=2
+        )
+        ds = build_random_effect_dataset(game, cfg, intercept_index=d - 1)
+        tag_codes = game.id_tags["userId"].host_codes()
+        for e in range(ds.num_entities):
+            rows = np.nonzero(tag_codes == e)[0]
+            got = sorted(
+                int(f) for f in ds.proj_all[e] if f >= 0
+            )
+            if rows.size < 2:
+                assert got == [], (e, got)
+                continue
+            want = sorted(np.nonzero((x[rows] != 0).any(axis=0))[0].tolist())
+            assert got == want, (e, got, want)
