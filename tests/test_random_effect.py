@@ -492,3 +492,38 @@ class TestDensePresenceUnion:
                 continue
             want = sorted(np.nonzero((x[rows] != 0).any(axis=0))[0].tolist())
             assert got == want, (e, got, want)
+
+
+class TestBucketScoring:
+    def test_bucket_scorer_matches_gather_with_passive_rows(self, rng):
+        """score_dataset's bucket-slab path (covered rows via GEMM +
+        passive remainder via subset gather) must equal the raw-gather
+        scorer exactly — including rows beyond the reservoir cap and rows
+        of inactive entities."""
+        from photon_tpu.models.game import (
+            _score_via_buckets,
+            score_raw_features,
+        )
+
+        game, entities = _toy_game_dataset(rng, n=260, num_entities=12)
+        cfg = RandomEffectDataConfiguration(
+            "userId", "shard",
+            active_data_upper_bound=9,  # forces passive rows
+            active_data_lower_bound=2,  # forces inactive entities
+        )
+        ds = build_random_effect_dataset(game, cfg, intercept_index=5)
+        assert ds.is_lazy
+        _, passive = ds.covered_row_partition()
+        assert passive.size > 0, "workload must exercise the passive path"
+
+        w = jnp.asarray(
+            rng.normal(size=(ds.num_entities, ds.max_sub_dim))
+        )
+        got = _score_via_buckets(w, ds)
+        assert got is not None, "bucket path must be applicable here"
+        want = score_raw_features(
+            w, ds.score_codes, ds.raw, ds.proj_dev
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-9
+        )
