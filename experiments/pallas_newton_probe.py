@@ -1,0 +1,92 @@
+"""Probe: fused Newton-iteration pallas kernel vs XLA einsums at bench shapes.
+
+Stage 1: just the z/H/g build (no CG) in one slab pass, flat [B, R*S] input.
+"""
+import time
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+B, R, S = 99_976, 64, 17
+BT = 8  # entities per kernel instance
+
+rng = np.random.default_rng(0)
+x_np = rng.normal(size=(B, R, S)).astype(np.float32)
+x_flat = jnp.asarray(x_np.reshape(B, R * S))
+x_brs = jnp.asarray(x_np)
+w = jnp.asarray(rng.normal(size=(B, S)).astype(np.float32) * 0.1)
+y = jnp.asarray((rng.random((B, R)) > 0.5).astype(np.float32))
+wt = jnp.asarray(rng.random((B, R)).astype(np.float32))
+off = jnp.zeros((B, R), jnp.float32)
+
+
+def kernel(x_ref, w_ref, y_ref, wt_ref, off_ref, h_ref, g_ref):
+    x = x_ref[...]
+    wv = w_ref[...]
+    # Batched dots don't lower in this pallas version; unroll the (static)
+    # entity block with 2D dot_generals.
+    for j in range(BT):
+        xj = x[j]  # [R, S]
+        z = (xj @ wv[j][:, None])[:, 0] + off_ref[j, :]
+        p = jax.nn.sigmoid(z)
+        c = wt_ref[j, :] * p * (1 - p)
+        d1 = wt_ref[j, :] * (p - y_ref[j, :])
+        h_ref[j, :, :] = xj.T @ (c[:, None] * xj)
+        g_ref[j, :] = (xj.T @ d1[:, None])[:, 0]
+
+
+@jax.jit
+def fused(x3, w, y, wt, off):
+    nb = B // BT
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((BT, R, S), lambda i: (i, 0, 0)),
+            pl.BlockSpec((BT, S), lambda i: (i, 0)),
+            pl.BlockSpec((BT, R), lambda i: (i, 0)),
+            pl.BlockSpec((BT, R), lambda i: (i, 0)),
+            pl.BlockSpec((BT, R), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((BT, S, S), lambda i: (i, 0, 0)),
+            pl.BlockSpec((BT, S), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S, S), jnp.float32),
+            jax.ShapeDtypeStruct((B, S), jnp.float32),
+        ],
+    )(x3, w, y, wt, off)
+
+
+@jax.jit
+def xla_version(x, w, y, wt, off):
+    z = jnp.einsum("brs,bs->br", x, w) + off
+    p = jax.nn.sigmoid(z)
+    c = wt * p * (1 - p)
+    d1 = wt * (p - y)
+    h = jnp.einsum("brs,br,brt->bst", x, c, x)
+    g = jnp.einsum("brs,br->bs", x, d1)
+    return h, g
+
+
+assert B % BT == 0 or True
+Bpad = (B // BT) * BT  # truncate for the probe
+xf, xb = x_flat[:Bpad], x_brs[:Bpad]
+wv, yv, wtv, offv = w[:Bpad], y[:Bpad], wt[:Bpad], off[:Bpad]
+
+h1, g1 = fused(xb, wv, yv, wtv, offv)
+h2, g2 = xla_version(xb, wv, yv, wtv, offv)
+print("parity h:", float(jnp.max(jnp.abs(h1 - h2))),
+      "g:", float(jnp.max(jnp.abs(g1 - g2))))
+
+for name, fn, args in (("pallas", fused, (xb, wv, yv, wtv, offv)),
+                       ("xla", xla_version, (xb, wv, yv, wtv, offv))):
+    float(np.asarray(fn(*args)[1]).sum())
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(np.asarray(fn(*args)[1]).sum())
+    print(f"{name}: {(time.perf_counter()-t0)/5*1000:.1f} ms per H/g build")
