@@ -1,0 +1,71 @@
+"""Audit: where do the bench's 191 cold-compile seconds go?
+
+Runs the bench's logistic variant once with jax_log_compiles plus wall-clock
+stamps around prepare / first fit, and a per-program compile-time summary
+parsed from JAX's logging. Round-5 instrumentation; not part of the package.
+"""
+
+import logging
+import re
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_log_compiles", True)
+
+sys.path.insert(0, "/root/repo")
+import bench  # noqa: E402
+
+
+class CompileLog(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.events = []  # (t, seconds, name)
+
+    def emit(self, record):
+        msg = record.getMessage()
+        m = re.search(r"Finished XLA compilation of (.+?) in (\d+\.\d+) sec",
+                      msg)
+        if m:
+            self.events.append(
+                (time.perf_counter(), float(m.group(2)), m.group(1)))
+            print(f"[{time.perf_counter() - T0:8.2f}s] compiled "
+                  f"{m.group(1)[:70]} in {m.group(2)}s", flush=True)
+
+
+handler = CompileLog()
+logging.getLogger("jax._src.interpreters.pxla").addHandler(handler)
+logging.getLogger("jax._src.dispatch").addHandler(handler)
+logging.getLogger("jax").addHandler(handler)
+logging.getLogger("jax").setLevel(logging.DEBUG)
+
+T0 = time.perf_counter()
+
+
+def stamp(label):
+    print(f"[{time.perf_counter() - T0:8.2f}s] {label}", flush=True)
+
+
+stamp("build_data start")
+data = bench.build_data("logistic")
+stamp("build_data done")
+est = bench.build_estimator("logistic")
+datasets, _ = est.prepare(data)
+stamp("prepare done")
+
+import numpy as np  # noqa: E402
+
+r = est.fit(data)[0]
+for m in r.model.models.values():
+    c = (m.coefficients if hasattr(m, "coefficients")
+         else m.model.coefficients.means)
+    float(np.asarray(c).sum())
+stamp("first fit done")
+
+total_compile = sum(s for _, s, _ in handler.events)
+print(f"\nprograms compiled: {len(handler.events)}; "
+      f"sum of compile seconds: {total_compile:.1f} "
+      f"(wall inside first fit differs if concurrent)")
+for t, s, name in sorted(handler.events, key=lambda e: -e[1])[:25]:
+    print(f"  {s:8.2f}s  {name[:90]}")

@@ -1,0 +1,118 @@
+"""Audit 2: break the first fit's wall-clock into phases (post compile-fix).
+
+Blocks after every coordinate update in the first fit so the stamps show
+which program's FIRST execution (load) is slow on the tunneled backend.
+Run this with the machine otherwise idle — concurrent CPU load (e.g. a
+pytest run) inflates the tunnel client's dispatch path badly.
+"""
+
+import logging
+import sys
+import time
+
+logging.basicConfig(level=logging.INFO)
+
+sys.path.insert(0, "/root/repo")
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+
+T0 = time.perf_counter()
+
+
+def stamp(label):
+    print(f"[{time.perf_counter() - T0:8.2f}s] {label}", flush=True)
+
+
+import jax  # noqa: E402
+
+import photon_tpu.estimators.game_estimator as ge  # noqa: E402
+from photon_tpu.algorithm import random_effect as re_mod  # noqa: E402
+from photon_tpu.algorithm import coordinate as fe_mod  # noqa: E402
+
+orig_prime = ge.GameEstimator._prime_compilations
+
+
+def prime(self, *a, **k):
+    stamp("prime start")
+    orig_prime(self, *a, **k)
+    stamp("prime done")
+
+
+ge.GameEstimator._prime_compilations = prime
+
+BLOCKING = [True]
+
+orig_re_train = re_mod.RandomEffectCoordinate.train
+
+
+def re_train(self, *a, **k):
+    t = time.perf_counter()
+    out = orig_re_train(self, *a, **k)
+    if BLOCKING[0]:
+        np.asarray(out[0].coefficients).sum()
+        stamp(
+            f"re train {self.dataset.config.random_effect_type} "
+            f"blocked in {time.perf_counter() - t:.2f}s"
+        )
+    return out
+
+
+re_mod.RandomEffectCoordinate.train = re_train
+
+orig_fe_train = fe_mod.FixedEffectCoordinate.train
+
+
+def fe_train(self, *a, **k):
+    t = time.perf_counter()
+    out = orig_fe_train(self, *a, **k)
+    if BLOCKING[0]:
+        np.asarray(out[0].coefficients.means).sum()
+        stamp(f"fe train blocked in {time.perf_counter() - t:.2f}s")
+    return out
+
+
+fe_mod.FixedEffectCoordinate.train = fe_train
+
+orig_re_score = re_mod.RandomEffectCoordinate.score
+
+
+def re_score(self, model):
+    t = time.perf_counter()
+    out = orig_re_score(self, model)
+    if BLOCKING[0]:
+        jax.block_until_ready(out)
+        np.asarray(out[:1])
+        stamp(
+            f"re score {self.dataset.config.random_effect_type} "
+            f"blocked in {time.perf_counter() - t:.2f}s"
+        )
+    return out
+
+
+re_mod.RandomEffectCoordinate.score = re_score
+
+stamp("build_data start")
+data = bench.build_data("logistic")
+stamp("build_data done")
+est = bench.build_estimator("logistic")
+datasets, _ = est.prepare(data)
+stamp("prepare done")
+
+
+def fit_blocking():
+    r = est.fit(data)[0]
+    for m in r.model.models.values():
+        c = (m.coefficients if hasattr(m, "coefficients")
+             else m.model.coefficients.means)
+        float(np.asarray(c).sum())
+    return r
+
+
+fit_blocking()
+stamp("first fit done")
+BLOCKING[0] = False
+for i in range(2):
+    t = time.perf_counter()
+    fit_blocking()
+    stamp(f"steady fit {i} done in {time.perf_counter() - t:.2f}s")

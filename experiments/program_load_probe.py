@@ -1,0 +1,106 @@
+"""Probe: where the tunneled backend's per-program first-execution tax
+comes from.
+
+Three program families, each compiled AOT then timed on first and second
+execution (first minus second = hidden load/warmup cost):
+  trivial  — one fused elementwise program;
+  looped   — fori_loop of matmuls (sequential structure, no vmap);
+  newtonish — vmap over B entities of while_loop(fori_loop CG) on tiny
+              shapes, structurally like the production bucket solver.
+
+Vary B to see whether the tax scales with device work or program
+structure. Run idle.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def time_one(name, f, x):
+    t0 = time.perf_counter()
+    c = jax.jit(f).lower(x).compile()
+    t_c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.asarray(c(x)[0] if isinstance(c(x), tuple) else c(x))
+    t_1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.asarray(c(x)[0] if isinstance(c(x), tuple) else c(x))
+    t_2 = time.perf_counter() - t0
+    print(f"{name:28s} compile {t_c:7.2f}s  first {t_1:7.2f}s  "
+          f"second {t_2:7.3f}s", flush=True)
+
+
+def trivial(x):
+    return jnp.tanh(x * 2.0 + 1.0).sum()
+
+
+def looped(x):
+    def body(_, s):
+        return jnp.tanh(s @ s * 1e-3)
+
+    return lax.fori_loop(0, 30, body, x)
+
+
+def make_newtonish(s=17, r=64):
+    def solve_one(xe, ye):
+        w0 = jnp.zeros(s, xe.dtype)
+
+        def cg(h, b):
+            def step(_, st):
+                xx, rr, p, rs = st
+                hp = h @ p
+                a = rs / jnp.maximum(p @ hp, 1e-30)
+                xx = xx + a * p
+                rr = rr - a * hp
+                rs2 = rr @ rr
+                return xx, rr, rs2 / jnp.maximum(rs, 1e-30) * p + rr, rs2
+
+            st = (jnp.zeros_like(b), b, b, b @ b)
+            return lax.fori_loop(0, s, step, st)[0]
+
+        def cond(st):
+            return st[2] < 8
+
+        def body(st):
+            w, f, it = st
+            z = xe @ w
+            sig = jax.nn.sigmoid(z)
+            g = xe.T @ (sig - ye)
+            h = xe.T @ (xe * (sig * (1 - sig))[:, None]) + jnp.eye(s)
+            d = cg(h, -g)
+            ts = 0.5 ** jnp.arange(8.0)
+            zt = z[None] + ts[:, None] * (xe @ d)[None]
+            ft = jnp.sum(jnp.logaddexp(0.0, zt) - zt * ye[None], axis=1)
+            best = jnp.argmax(ft <= f)
+            w = w + ts[best] * d
+            return w, ft[best], it + 1
+
+        w, f, _ = lax.while_loop(
+            cond, body, (w0, jnp.asarray(1e30, xe.dtype),
+                         jnp.asarray(0, jnp.int32)))
+        return w
+
+    def f(args):
+        xs, ys = args
+        return jax.vmap(solve_one)(xs, ys)
+
+    return f
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    time_one("trivial [4M]", trivial, jnp.ones((4_000_000,), jnp.float32))
+    time_one("looped [512,512]x30", looped,
+             jax.random.normal(key, (512, 512), jnp.float32))
+    for b in (1_000, 100_000):
+        xs = jax.random.normal(key, (b, 64, 17), jnp.float32)
+        ys = (jax.random.uniform(key, (b, 64)) > 0.5).astype(jnp.float32)
+        time_one(f"newtonish B={b}", make_newtonish(), (xs, ys))
+
+
+if __name__ == "__main__":
+    main()

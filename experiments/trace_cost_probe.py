@@ -1,0 +1,75 @@
+"""Probe: split the production bucket-solver's cost into trace (lower) /
+XLA compile / first execution, on the real bench shapes.
+
+If lowering dominates, the compile blowup is Python tracing, not XLA.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+
+
+def stamp(label, t0):
+    print(f"{label}: {time.perf_counter() - t0:.2f}s", flush=True)
+
+
+data = bench.build_data("logistic")
+est = bench.build_estimator("logistic")
+t0 = time.perf_counter()
+datasets, _ = est.prepare(data)
+stamp("prepare", t0)
+
+coords = est._build_coordinates(
+    datasets, {}, {}, logical_rows=data.num_samples)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from photon_tpu.algorithm import random_effect as re_mod  # noqa: E402
+
+coord = coords["per-user"].inner if hasattr(coords["per-user"], "inner") \
+    else coords["per-user"]
+ds = coord.dataset
+
+t0 = time.perf_counter()
+blocks = ds.device_blocks()
+stamp("device_blocks (materialize compile+run)", t0)
+
+dtype = jnp.dtype(ds.dtype)
+residuals = jnp.zeros(ds.num_rows, dtype)
+w0_full = jnp.zeros((ds.num_entities, ds.max_sub_dim), dtype)
+
+for i, block in enumerate(blocks):
+    shape = tuple(np.asarray(block.row_ids).shape) if hasattr(
+        block, "row_ids") else "?"
+    print(f"-- bucket {i}: rows shape {shape}, sub_dim {block.sub_dim}",
+          flush=True)
+    # Reproduce _dispatch_block's call but staged: lower, compile, run.
+    kwargs = dict(
+        sub_dim=block.sub_dim,
+        task=coord.task,
+        opt_config=coord.config.optimizer,
+        use_owlqn=False,
+        variance_computation=coord.config.variance_computation,
+        direct=False,
+        newton=True,
+    )
+    args = (
+        block, residuals, None, None, w0_full,
+        np.asarray(0.0, dtype=dtype), np.asarray(1.0, dtype=dtype),
+        np.asarray(1.0, dtype=dtype), None, w0_full, None,
+    )
+    t0 = time.perf_counter()
+    lowered = re_mod._solve_block.lower(*args, **kwargs)
+    stamp("   lower (trace)", t0)
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    stamp("   XLA compile", t0)
+    t0 = time.perf_counter()
+    out = compiled(*args)
+    np.asarray(out[0]).sum()
+    stamp("   first exec (AOT-compiled)", t0)

@@ -1,0 +1,39 @@
+"""Measure the logistic variant's compile phase with the persistent cache
+enabled, in this process. Run twice (two processes) to compare cold-ish vs
+warm-cache behavior."""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+from photon_tpu.utils import enable_compilation_cache  # noqa: E402
+
+print("cache dir:", enable_compilation_cache(), flush=True)
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+
+t0 = time.perf_counter()
+data = bench.build_data("logistic")
+print(f"build_data {time.perf_counter() - t0:.1f}s", flush=True)
+est = bench.build_estimator("logistic")
+t0 = time.perf_counter()
+datasets, _ = est.prepare(data)
+print(f"prepare {time.perf_counter() - t0:.1f}s", flush=True)
+
+t0 = time.perf_counter()
+r = est.fit(data)[0]
+for m in r.model.models.values():
+    c = (m.coefficients if hasattr(m, "coefficients")
+         else m.model.coefficients.means)
+    float(np.asarray(c).sum())
+print(f"first fit {time.perf_counter() - t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+r = est.fit(data)[0]
+for m in r.model.models.values():
+    c = (m.coefficients if hasattr(m, "coefficients")
+         else m.model.coefficients.means)
+    float(np.asarray(c).sum())
+print(f"second fit {time.perf_counter() - t0:.1f}s", flush=True)

@@ -193,21 +193,31 @@ def _spd_solve_cg(h: Array, b: Array, sub_dim: int) -> Array:
     batch cleanly into GEMMs. For SPD H (strict convexity + the unit
     padding diagonal) CG is exact after S steps up to roundoff; sub_dim is
     small by construction (LinearSubspaceProjector compression).
+
+    In float32 S-step CG is NOT backward-stable on ill-conditioned H
+    (relative error ~0.5 at cond(H)=1e4 measured), so one round of
+    iterative refinement follows: ``x += cg(H, b - H x)``. Both passes are
+    the same batched GEMM shapes; the refined solve tracks a direct fp32
+    Cholesky down to cond(H)~1e6.
     """
 
-    def cg_step(_, state):
-        x, r, p, rs = state
-        hp = h @ p
-        alpha = rs / jnp.maximum(jnp.dot(p, hp), 1e-30)
-        x = x + alpha * p
-        r = r - alpha * hp
-        rs_new = jnp.dot(r, r)
-        p = r + (rs_new / jnp.maximum(rs, 1e-30)) * p
-        return x, r, p, rs_new
+    def run_cg(rhs):
+        def cg_step(_, state):
+            x, r, p, rs = state
+            hp = h @ p
+            alpha = rs / jnp.maximum(jnp.dot(p, hp), 1e-30)
+            x = x + alpha * p
+            r = r - alpha * hp
+            rs_new = jnp.dot(r, r)
+            p = r + (rs_new / jnp.maximum(rs, 1e-30)) * p
+            return x, r, p, rs_new
 
-    init = (jnp.zeros_like(b), b, b, jnp.dot(b, b))
-    x, _, _, _ = lax.fori_loop(0, sub_dim, cg_step, init)
-    return x
+        init = (jnp.zeros_like(rhs), rhs, rhs, jnp.dot(rhs, rhs))
+        x, _, _, _ = lax.fori_loop(0, sub_dim, cg_step, init)
+        return x
+
+    x = run_cg(b)
+    return x + run_cg(b - h @ x)
 
 
 def _solve_one_entity_direct(
@@ -421,6 +431,14 @@ def _solve_one_entity_newton(
         h = h + jnp.diag(l2_diag + (1.0 - valid_mask))
         d = _spd_solve_cg(h, -g, sub_dim) * valid_mask
         gd = jnp.dot(g, d)
+        # Refined fp32 CG can still return a non-descent direction on a
+        # near-singular Hessian; Armijo would then reject every trial and
+        # the loop would exit at a non-optimum. Fall back to steepest
+        # descent for such iterations — guaranteed descent, and the next
+        # iteration's Hessian is evaluated at the new point.
+        bad = gd >= 0.0
+        d = jnp.where(bad, -g, d)
+        gd = jnp.where(bad, -jnp.sum(g * g), gd)
 
         zd = x @ d  # [R]; z_t = z + t * zd for every trial t
         z_t = z[None, :] + trial_ts[:, None] * zd[None, :]  # [T, R]

@@ -200,6 +200,9 @@ class TrainingConfig:
     # dataSummaryDirectory): when set, each shard's stats are written as
     # FeatureSummarizationResultAvro under <dir>/<shardId>/.
     data_summary_dir: str | None = None
+    # Reserved-column remapping (InputColumnsNames.scala:80-88): keys
+    # uid/response/offset/weight/metadataMap -> actual field names.
+    input_columns: dict[str, str] | None = None
 
     @staticmethod
     def load(path: str) -> "TrainingConfig":
@@ -239,6 +242,7 @@ class TrainingConfig:
             days_range=raw.get("input", {}).get("days_range"),
             mesh=raw.get("mesh", "auto"),
             data_summary_dir=raw.get("data_summary_dir"),
+            input_columns=raw.get("input", {}).get("input_columns"),
         )
 
     def opt_config_sequence(self) -> list[dict[str, GLMOptimizationConfiguration]]:

@@ -40,6 +40,12 @@ def main(argv=None) -> int:
                         help="top-level record fields to expose as id tags")
     parser.add_argument("--data-validation", default="DISABLED",
                         help="FULL | SAMPLE | DISABLED")
+    parser.add_argument("--input-columns", nargs="*", default=None,
+                        metavar="COL=FIELD",
+                        help="remap reserved record fields "
+                             "(uid/response/offset/weight/metadataMap), "
+                             "e.g. weight=sampleWeight "
+                             "(InputColumnsNames.scala:80-88)")
     parser.add_argument("--mesh", default="auto",
                         help="multi-device scoring: auto (all devices), "
                              "off, or a device count")
@@ -76,6 +82,16 @@ def _run(args) -> int:
     # Feature index built from the scoring data's keys. Model features absent
     # from the data are dropped at model load; that is harmless — a feature
     # no row carries contributes zero margin either way.
+    input_columns = None
+    if args.input_columns:
+        bad = [kv for kv in args.input_columns if "=" not in kv]
+        if bad:
+            raise SystemExit(
+                f"--input-columns operands must be COL=FIELD, got {bad}")
+        input_columns = dict(
+            kv.split("=", 1) for kv in args.input_columns
+        )
+
     records = avro.read_container_dir(args.input)
     needed_shards = set()
     import os.path as osp
@@ -103,6 +119,7 @@ def _run(args) -> int:
             feature_shards=shard_bags,
             id_columns=args.id_columns,
             id_tag_names=args.id_tags,
+            input_columns=input_columns,
             records=records,
         )
         model, metadata = load_game_model(args.model_dir, index_maps)
@@ -117,7 +134,7 @@ def _run(args) -> int:
         index_map = build_index_map_from_records(records)
         data, _ = read_training_examples(
             args.input, index_map=index_map, id_tag_names=args.id_tags,
-            records=records,
+            input_columns=input_columns, records=records,
         )
         index_maps = {s: index_map for s in needed_shards} or {
             "features": index_map}

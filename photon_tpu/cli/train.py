@@ -187,6 +187,7 @@ def _run(args) -> int:
             index_maps=prebuilt_maps,
             id_columns=cfg.id_columns,
             id_tag_names=cfg.id_tags,
+            input_columns=cfg.input_columns,
             add_intercept=cfg.shard_intercepts(),
             records=train_records,
         )
@@ -199,6 +200,7 @@ def _run(args) -> int:
                 index_maps=multi_shard_maps,
                 id_columns=cfg.id_columns,
                 id_tag_names=cfg.id_tags,
+                input_columns=cfg.input_columns,
                 records=val_records,
             )
     elif cfg.input_format == "avro":
@@ -206,6 +208,7 @@ def _run(args) -> int:
             cfg.train_path,
             index_map=prebuilt_features_map,
             id_tag_names=cfg.id_tags,
+            input_columns=cfg.input_columns,
             records=train_records,
         )
         validation = None
@@ -214,6 +217,7 @@ def _run(args) -> int:
                 cfg.validation_path,
                 index_map=index_map,
                 id_tag_names=cfg.id_tags,
+                input_columns=cfg.input_columns,
                 records=val_records,
             )
     elif cfg.input_format == "libsvm":

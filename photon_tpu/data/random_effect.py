@@ -344,6 +344,9 @@ class RandomEffectDataset:
     # so per-fit bookkeeping never pulls from the device.
     block_codes_np: tuple = ()
     block_intercepts_np: tuple = ()
+    # [n] bool host mask: rows kept into some training block (built from the
+    # planner's rows_flat, so no device work is needed to derive it).
+    covered_np: np.ndarray | None = None
 
     @property
     def num_rows(self) -> int:
@@ -393,28 +396,38 @@ class RandomEffectDataset:
         return out
 
     def covered_row_partition(self):
-        """(covered_mask [n] bool on device, passive_rows host int32 array).
+        """(covered_mask [n] bool HOST array, passive_rows host int32 array).
 
         "Covered" rows appear in some training block (the active kept
         rows); "passive" rows — beyond the reservoir cap or owned by
         inactive entities with a trained model — still need scoring
         (RandomEffectDataset's activeData/passiveData split, :631-640).
         Cached per dataset.
+
+        Derived ENTIRELY on the host: the planner's kept-row lists are host
+        arrays, and the former device derivation (per-bucket eager
+        iota/compare/scatter-max at 4M-row shapes) cost ~95s of one-off XLA
+        compiles per fit on the tunneled TPU backend.
         """
         cached = getattr(self, "_covered", None)
         if cached is not None:
             return cached
         assert self.is_lazy, "row partition is defined for lazy datasets"
-        n = self.num_rows
-        covered = jnp.zeros(n, dtype=bool)
-        for b in self.blocks:
-            # BlockPlan rank-vs-count is exact row validity (a real row
-            # with data weight 0 is still covered and must score).
-            r = b.row_ids.shape[1]
-            valid = jnp.arange(r, dtype=jnp.int32)[None, :] < (
-                b.row_counts[:, None])
-            covered = covered.at[b.row_ids].max(valid)
-        passive = np.nonzero(~np.asarray(covered))[0].astype(np.int32)
+        if self.covered_np is not None:
+            covered = self.covered_np
+        else:
+            # Fallback for datasets built before covered_np existed (e.g.
+            # dataclasses.replace-based shims in tests): one host pass over
+            # the block plans. A real row with data weight 0 is still
+            # covered and must score.
+            covered = np.zeros(self.num_rows, dtype=bool)
+            for b in self.blocks:
+                rows = np.asarray(b.row_ids)
+                counts = np.asarray(b.row_counts)
+                r = rows.shape[1]
+                valid = np.arange(r, dtype=np.int32)[None, :] < counts[:, None]
+                covered[rows[valid]] = True
+        passive = np.nonzero(~covered)[0].astype(np.int32)
         result = (covered, passive)
         object.__setattr__(self, "_covered", result)
         return result
@@ -881,8 +894,10 @@ def _plan_arrays_to_device(arrays: list[np.ndarray]):
     first-transfer setup cost (~65ms each); 15+ distinct plan-array shapes
     made that the dominant ingest cost. Packing everything into ONE int32
     buffer pays one transfer and one (persistently cached, trivial) split
-    program instead. The buffer length is padded to a power of two so its
-    transfer shape recurs across datasets.
+    program instead. The buffer length is padded to a 4 MiB granule so its
+    transfer shape recurs across similarly-sized datasets with bounded
+    (< 4 MiB) padding overhead — power-of-two padding could nearly double
+    host memory and transfer bytes at n = 2^k + 1.
     """
     total = sum(a.nbytes for a in arrays)
     if total < _PACKED_TRANSFER_MIN_BYTES or any(
@@ -891,7 +906,8 @@ def _plan_arrays_to_device(arrays: list[np.ndarray]):
         return jax.device_put(arrays)
     shapes = tuple(a.shape for a in arrays)
     n = sum(a.size for a in arrays)
-    n_pad = 1 << max(int(np.ceil(np.log2(max(n, 1)))), 0)
+    granule = (4 << 20) // 4  # 4 MiB of int32 elements
+    n_pad = max(-(-n // granule) * granule, granule)
     flat = np.empty(n_pad, dtype=np.int32)
     o = 0
     for a in arrays:
@@ -1187,6 +1203,10 @@ def build_random_effect_dataset(
             r_of=r_of,
         ))
 
+    covered_np = np.zeros(plan.codes.shape[0], dtype=bool)
+    for bh in bucket_host:
+        covered_np[bh["rows_flat"]] = True
+
     ell_idx = ell_val = ell_tail = None
     if not lazy:
         ell_idx, ell_val, _ = game_data.host_shard_coo(
@@ -1209,7 +1229,7 @@ def build_random_effect_dataset(
         def finalize(devs):
             return _finalize_lazy(
                 devs, bucket_host, feats, game_data, config, num_entities,
-                tag, plan, dtype,
+                tag, plan, dtype, covered_np,
             )
 
         if defer_transfer:
@@ -1291,12 +1311,13 @@ def build_random_effect_dataset(
         score_tail_values=tail_v,
         block_codes_np=tuple(bh["members"] for bh in bucket_host),
         block_intercepts_np=tuple(bh["intercepts"] for bh in bucket_host),
+        covered_np=covered_np,
     )
 
 
 def _finalize_lazy(
     devs, bucket_host, feats, game_data, config, num_entities, tag, plan,
-    dtype,
+    dtype, covered_np=None,
 ):
     """Assemble the lazy RandomEffectDataset from placed plan arrays."""
     blocks = []
@@ -1330,4 +1351,5 @@ def _finalize_lazy(
         block_intercepts_np=tuple(
             bh["intercepts"] for bh in bucket_host
         ),
+        covered_np=covered_np,
     )

@@ -473,8 +473,12 @@ class GameEstimator:
         (not only the compile) is seconds, which executing the thunk pays
         once and the CD sweep then reuses.
         """
-        key = id(datasets)
-        if getattr(self, "_primed_datasets", None) == key:
+        # Identity (not id()): a dead dict's address can be reused, which
+        # would silently skip priming for a NEW dataset set. prepare()
+        # clears this on every rebuild, so the reference held here never
+        # outlives the _fit_cache generation it belongs to (no double
+        # retention of device datasets across fits).
+        if getattr(self, "_primed_datasets", None) is datasets:
             return
         if self.resolve_mesh() is not None:
             return
@@ -495,7 +499,7 @@ class GameEstimator:
         with ThreadPoolExecutor(max_workers=min(8, len(thunks))) as pool:
             for f in [pool.submit(t) for t in thunks]:
                 f.result()
-        self._primed_datasets = key
+        self._primed_datasets = datasets
 
     def _build_validation(
         self,
@@ -564,6 +568,11 @@ class GameEstimator:
             a is b for a, b in zip(cached[0], cache_key)
         ):
             return cached[1]
+        # Release the previous generation's datasets BEFORE building the
+        # new one — _primed_datasets would otherwise pin the old device
+        # arrays through the build (2x peak HBM).
+        self._primed_datasets = None
+        self._fit_cache = None
         datasets = self._build_datasets(data, initial_model)
         val_ctx = (
             self._build_validation(datasets, validation)

@@ -45,6 +45,7 @@ def read_training_examples(
     *,
     index_map: IndexMap | None = None,
     id_tag_names: list[str] | None = None,
+    input_columns: dict[str, str] | None = None,
     add_intercept: bool = True,
     dtype=jnp.float32,
     records: list[dict] | None = None,
@@ -52,17 +53,20 @@ def read_training_examples(
     """Read a TrainingExampleAvro file/dir into a GameDataset.
 
     ``id_tag_names`` picks metadataMap entries to expose as id tags; when
-    None every metadata key found in the data is used. ``records`` supplies
-    already-parsed Avro records for ``path`` to skip a re-parse; without it
-    the file is STREAMED block by block (peak host memory is the output
-    arrays plus one decode chunk, not a list of record dicts).
+    None every metadata key found in the data is used. ``input_columns``
+    remaps the reserved record fields (see ``read_merged``). ``records``
+    supplies already-parsed Avro records for ``path`` to skip a re-parse;
+    without it the file is STREAMED block by block (peak host memory is the
+    output arrays plus one decode chunk, not a list of record dicts).
     """
+    response = (input_columns or {}).get("response", "label")
     game, maps = read_merged(
         path,
         feature_shards={"features": ["features"]},
         index_maps=None if index_map is None else {"features": index_map},
         id_tag_names="auto" if id_tag_names is None else id_tag_names,
-        response_field="label",
+        response_field=response,
+        input_columns=input_columns,
         add_intercept=add_intercept,
         dtype=dtype,
         records=records,
@@ -128,6 +132,7 @@ def read_merged(
     id_columns: list[str] | None = None,
     id_tag_names=None,  # list[str] | None | "auto"
     response_field: str | None = None,
+    input_columns: dict[str, str] | None = None,
     add_intercept: bool | dict[str, bool] = True,
     dtype=jnp.float32,
     records: list[dict] | None = None,
@@ -151,7 +156,34 @@ def read_merged(
     the output arrays plus one decode block, the O(batch) requirement of
     the ingest pipeline (the reference amortizes the same passes across a
     cluster, AvroDataReader.scala:85).
+
+    ``input_columns`` remaps ALL reserved record fields, the full
+    InputColumnsNames surface (InputColumnsNames.scala:80-88): keys
+    "uid" / "response" / "offset" / "weight" / "metadataMap", each mapped
+    to the actual field name in the data. ``response_field`` (legacy
+    single-field form) takes precedence over ``input_columns["response"]``.
     """
+    cols = {
+        "uid": "uid",
+        "response": None,
+        "offset": "offset",
+        "weight": "weight",
+        "metadataMap": "metadataMap",
+    }
+    if input_columns:
+        unknown = sorted(set(input_columns) - set(cols))
+        if unknown:
+            raise ValueError(
+                f"unknown input_columns key(s) {unknown}; reserved columns "
+                f"are {sorted(cols)} (InputColumnsNames.scala:80-88)")
+        cols.update(input_columns)
+    if response_field is None:
+        response_field = cols["response"]
+    uid_col = cols["uid"]
+    offset_col = cols["offset"]
+    weight_col = cols["weight"]
+    meta_col = cols["metadataMap"]
+
     def shard_intercept(shard: str) -> bool:
         if isinstance(add_intercept, dict):
             return add_intercept.get(shard, True)
@@ -197,7 +229,7 @@ def read_merged(
                     for f in rec.get(bag) or ():
                         ks.add(make_feature_key(f["name"], f["term"]))
             if id_tag_names == "auto":
-                meta_keys.update((rec.get("metadataMap") or {}).keys())
+                meta_keys.update((rec.get(meta_col) or {}).keys())
         if first is None:
             raise ValueError(f"no records in {path}")
         if response_field is None:
@@ -268,10 +300,10 @@ def read_merged(
     for i, rec in enumerate(stream()):
         c_labels.append(rec[response_field])
         c_offsets.append(
-            rec["offset"] if rec.get("offset") is not None else 0.0)
+            rec[offset_col] if rec.get(offset_col) is not None else 0.0)
         c_weights.append(
-            rec["weight"] if rec.get("weight") is not None else 1.0)
-        c_uids.append(_uid_to_int(rec.get("uid"), i))
+            rec[weight_col] if rec.get(weight_col) is not None else 1.0)
+        c_uids.append(_uid_to_int(rec.get(uid_col), i))
         for shard, bags in feature_shards.items():
             imap = out_maps[shard]
             row = []
@@ -288,7 +320,7 @@ def read_merged(
             if col not in rec or rec[col] is None:
                 raise ValueError(f"record {i} is missing id column {col!r}")
             c_tags[col].append(rec[col])
-        meta = rec.get("metadataMap") or {}
+        meta = rec.get(meta_col) or {}
         for t in id_tag_names or ():
             if t not in meta:
                 raise ValueError(
@@ -370,6 +402,69 @@ TRAINING_EXAMPLE_SCHEMA = {
         {"name": "offset", "type": ["null", "double"], "default": None},
     ],
 }
+
+
+RESPONSE_PREDICTION_SCHEMA = {
+    "name": "SimplifiedResponsePrediction",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "doc": (
+        "Response prediction format truncated with the only field photon "
+        "is expecting"
+    ),
+    "fields": [
+        {"name": "response", "type": "double"},
+        {"name": "features", "type": {
+            "items": {
+                "name": "FeatureAvro",
+                "namespace": "com.linkedin.photon.avro.generated",
+                "type": "record",
+                "fields": [
+                    {"name": "name", "type": "string"},
+                    {"name": "term", "type": "string"},
+                    {"name": "value", "type": "double"},
+                ],
+            },
+            "type": "array",
+        }},
+        {"name": "weight", "type": "double", "default": 1.0},
+        {"name": "offset", "type": "double", "default": 0.0},
+    ],
+}
+
+
+def write_response_predictions(
+    path: str,
+    responses,
+    feature_rows,  # list of [(feature_key, value)] in name+term key form
+    *,
+    weights=None,
+    offsets=None,
+) -> None:
+    """SimplifiedResponsePrediction writer
+    (photon-avro-schemas ResponsePredictionAvro.avsc) — the reference's
+    response-prediction data layout; readable back via ``read_merged`` with
+    ``response_field="response"`` (AvroDataReader handles both layouts
+    uniformly)."""
+    responses = np.asarray(responses)
+
+    def rec(i):
+        feats = []
+        for key, val in feature_rows[i]:
+            name, term = split_feature_key(key)
+            feats.append({"name": name, "term": term, "value": float(val)})
+        return {
+            "response": float(responses[i]),
+            "features": feats,
+            "weight": 1.0 if weights is None else float(weights[i]),
+            "offset": 0.0 if offsets is None else float(offsets[i]),
+        }
+
+    avro.write_container(
+        path,
+        RESPONSE_PREDICTION_SCHEMA,
+        (rec(i) for i in range(responses.shape[0])),
+    )
 
 
 def write_training_examples(

@@ -150,19 +150,39 @@ def _score_via_buckets(w: Array, ds: RandomEffectDataset) -> Array | None:
     _, passive = ds.covered_row_partition()
     if passive.size:
         pr = jnp.asarray(passive)
-        codes_p = jnp.take(ds.score_codes, pr)
         feats = ds.raw
         if isinstance(feats, DenseFeatures):
-            sub = DenseFeatures(jnp.take(feats.x, pr, axis=0))
-        else:
-            sub = SparseFeatures(
-                jnp.take(feats.indices, pr, axis=0),
-                jnp.take(feats.values, pr, axis=0),
-                feats.d,
+            z = _passive_score_set_dense(
+                z, pr, ds.score_codes, feats.x, w, ds.proj_dev
             )
-        zp = score_raw_features(w, codes_p, sub, ds.proj_dev)
-        z = z.at[pr].set(zp.astype(z.dtype))
+        else:
+            z = _passive_score_set_sparse(
+                z, pr, ds.score_codes, feats.indices, feats.values,
+                w, ds.proj_dev,
+            )
     return z
+
+
+@jax.jit
+def _passive_score_set_dense(z, pr, score_codes, x, w, proj_dev):
+    """Scatter passive-row scores into z as ONE program: the row-subset
+    gathers, the raw-feature score, and the set-scatter each compile as
+    separate half-second eager programs on the tunneled TPU backend
+    otherwise."""
+    codes_p = jnp.take(score_codes, pr)
+    zp = _score_raw_dense(w, codes_p, jnp.take(x, pr, axis=0), proj_dev)
+    return z.at[pr].set(zp.astype(z.dtype))
+
+
+@jax.jit
+def _passive_score_set_sparse(z, pr, score_codes, indices, values, w,
+                              proj_dev):
+    codes_p = jnp.take(score_codes, pr)
+    zp = _score_raw_sparse(
+        w, codes_p, jnp.take(indices, pr, axis=0),
+        jnp.take(values, pr, axis=0), proj_dev,
+    )
+    return z.at[pr].set(zp.astype(z.dtype))
 
 
 def score_entity_table(

@@ -340,3 +340,124 @@ class TestTrainingDataIO:
         assert len(recs) == 10
         assert recs[0]["modelId"] == "m"
         assert recs[3]["uid"] == "3"
+
+    def test_response_prediction_writer_round_trip(self, tmp_path, rng):
+        """SimplifiedResponsePrediction (ResponsePredictionAvro.avsc) write
+        -> read_merged round trip, including the non-null weight/offset
+        defaults the schema fixes at 1.0/0.0."""
+        from photon_tpu.io.avro_data import (
+            read_merged,
+            write_response_predictions,
+        )
+
+        n, d = 12, 3
+        keys = [f"f{i}{DELIMITER}t" for i in range(d)]
+        rows = [
+            [(keys[j], float(rng.normal()))
+             for j in rng.choice(d, size=2, replace=False)]
+            for i in range(n)
+        ]
+        responses = rng.normal(size=n)
+        weights = rng.uniform(0.5, 2.0, size=n)
+        offsets = rng.normal(size=n) * 0.1
+        p = str(tmp_path / "resp.avro")
+        write_response_predictions(
+            p, responses, rows, weights=weights, offsets=offsets)
+        _, recs = avro.read_container(p)
+        assert set(recs[0]) == {"response", "features", "weight", "offset"}
+        game, maps = read_merged(
+            p, feature_shards={"features": ["features"]},
+            response_field="response",
+        )
+        np.testing.assert_allclose(
+            np.asarray(game.labels), responses, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(game.weights), weights, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(game.offsets), offsets, rtol=1e-6)
+        imap = maps["features"]
+        feats = game.feature_shards["features"]
+        row0 = {int(i): float(v) for i, v in
+                zip(np.asarray(feats.indices[0]),
+                    np.asarray(feats.values[0])) if v != 0.0}
+        want = {imap.get_index(k): pytest.approx(v, rel=1e-6)
+                for k, v in rows[0]}
+        want[imap.intercept_index] = 1.0
+        assert row0 == want
+
+    def test_input_columns_remap_all_reserved(self, tmp_path, rng):
+        """Full InputColumnsNames remapping (InputColumnsNames.scala:80-88):
+        every reserved column read from a custom field name."""
+        from photon_tpu.io import avro as avro_mod
+        from photon_tpu.io.avro_data import read_merged
+
+        schema = {
+            "name": "CustomRow",
+            "type": "record",
+            "fields": [
+                {"name": "rowId", "type": "string"},
+                {"name": "target", "type": "double"},
+                {"name": "base", "type": "double"},
+                {"name": "importance", "type": "double"},
+                {"name": "ids", "type": {"type": "map", "values": "string"}},
+                {"name": "features", "type": {
+                    "items": {
+                        "name": "F", "type": "record",
+                        "fields": [
+                            {"name": "name", "type": "string"},
+                            {"name": "term", "type": "string"},
+                            {"name": "value", "type": "double"},
+                        ]},
+                    "type": "array"}},
+            ],
+        }
+        n = 9
+        labels = rng.normal(size=n)
+        offsets = rng.normal(size=n)
+        weights = rng.uniform(0.5, 2.0, size=n)
+        recs = [
+            {
+                "rowId": str(100 + i),
+                "target": float(labels[i]),
+                "base": float(offsets[i]),
+                "importance": float(weights[i]),
+                "ids": {"userId": f"u{i % 2}"},
+                "features": [
+                    {"name": "x", "term": "", "value": float(i + 1)}],
+            }
+            for i in range(n)
+        ]
+        p = str(tmp_path / "custom.avro")
+        avro_mod.write_container(p, schema, recs)
+        game, _ = read_merged(
+            p,
+            feature_shards={"features": ["features"]},
+            id_tag_names=["userId"],
+            input_columns={
+                "uid": "rowId",
+                "response": "target",
+                "offset": "base",
+                "weight": "importance",
+                "metadataMap": "ids",
+            },
+        )
+        np.testing.assert_allclose(np.asarray(game.labels), labels,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(game.offsets), offsets,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(game.weights), weights,
+                                   rtol=1e-6)
+        assert game.id_tags["userId"].num_groups == 2
+        # uids flow from the remapped column (numeric strings pass through).
+        assert np.asarray(game.uids).tolist() == [100 + i for i in range(n)]
+
+    def test_input_columns_unknown_key_raises(self, tmp_path):
+        from photon_tpu.io.avro_data import read_merged
+
+        p = str(tmp_path / "t.avro")
+        write_training_examples(p, [1.0], [[(f"f0{DELIMITER}t", 2.0)]])
+        with pytest.raises(ValueError, match="input_columns"):
+            read_merged(
+                p, feature_shards={"features": ["features"]},
+                input_columns={"label": "target"},
+            )
