@@ -1,0 +1,124 @@
+"""Profile one fused bench fit and print top TPU ops by total time.
+
+Hand-rolled xplane.pb parse (no tensorboard plugin in the image).
+Usage: python experiments/trace_top_ops.py [linear|logistic]
+"""
+
+import collections
+import glob
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, "/root/repo")
+
+
+def parse_msg(buf, handlers):
+    from google.protobuf.internal import decoder
+
+    pos, end = 0, len(buf)
+    while pos < end:
+        tag, pos = decoder._DecodeVarint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 2:
+            ln, pos = decoder._DecodeVarint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 0:
+            val, pos = decoder._DecodeVarint(buf, pos)
+        elif wt == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wt == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"wire type {wt}")
+        h = handlers.get(field)
+        if h:
+            h(val)
+
+
+def top_ops(xplane_path, top=30):
+    data = open(xplane_path, "rb").read()
+    planes = []
+    parse_msg(data, {1: planes.append})
+    for plane in planes:
+        name = [None]
+        lines = []
+        emeta = {}
+
+        def on_emeta(v):
+            key = [None]
+            val = [None]
+            parse_msg(v, {1: lambda x: key.__setitem__(0, x),
+                          2: lambda x: val.__setitem__(0, x)})
+            nm = [None]
+            dn = [None]
+            if val[0] is not None:
+                parse_msg(val[0], {
+                    2: lambda x: nm.__setitem__(
+                        0, x.decode() if isinstance(x, bytes) else None),
+                    4: lambda x: dn.__setitem__(
+                        0, x.decode() if isinstance(x, bytes) else None),
+                })
+            emeta[key[0]] = dn[0] or nm[0]
+
+        parse_msg(plane, {2: lambda v: name.__setitem__(0, v.decode()),
+                          3: lines.append, 4: on_emeta})
+        if name[0] != "/device:TPU:0":
+            continue
+        tot = collections.Counter()
+        cnt = collections.Counter()
+        for line in lines:
+            events = []
+            parse_msg(line, {4: events.append})
+            for ev in events:
+                mid = [0]
+                dur = [0]
+                parse_msg(ev, {1: lambda x: mid.__setitem__(0, x),
+                               3: lambda x: dur.__setitem__(0, x)})
+                nm = emeta.get(mid[0], f"id{mid[0]}")
+                tot[nm] += dur[0]
+                cnt[nm] += 1
+        print("== top TPU ops by total time")
+        for nm, ps in tot.most_common(top):
+            print(f"  {ps / 1e9:9.1f}ms x{cnt[nm]:5d}  {str(nm)[:110]}")
+
+
+def main():
+    task = sys.argv[1] if len(sys.argv) > 1 else "logistic"
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    import bench
+
+    data = bench.build_data(task)
+    est = bench.build_estimator(task)
+    est.prepare(data)
+
+    def fit():
+        r = est.fit(data)[0]
+        for m in r.model.models.values():
+            c = (m.coefficients if hasattr(m, "coefficients")
+                 else m.model.coefficients.means)
+            float(np.asarray(jnp.sum(c)))
+
+    fit()  # compile + load
+    tracedir = tempfile.mkdtemp(prefix="jaxtrace")
+    jax.profiler.start_trace(tracedir)
+    fit()
+    jax.profiler.stop_trace()
+    paths = glob.glob(os.path.join(
+        tracedir, "plugins/profile/*/*.xplane.pb"))
+    top_ops(paths[0])
+    shutil.rmtree(tracedir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

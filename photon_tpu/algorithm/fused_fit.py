@@ -1,0 +1,694 @@
+"""Whole-fit fused coordinate descent: ONE XLA program per GAME fit.
+
+The unfused ``CoordinateDescent`` dispatches one device program per bucket
+solve, per scorer, and per residual update — ~24 dispatches per fit on the
+bench workload. On a remote-attached TPU every *distinct program* pays a
+compile + first-execution round trip (seconds each, noisy under shared
+compiler load), and every *dispatch* pays RPC latency. This module traces
+the entire block-coordinate-descent fit — fixed-effect L-BFGS solves,
+batched per-entity Newton/Cholesky solves, scoring, and the
+``summed - old + previous`` residual algebra (CoordinateDescent.scala
+:442,583) — into one jitted program with a ``lax.fori_loop`` over CD
+iterations, so a fit is ONE compile and ONE dispatch.
+
+Semantics match the unfused loop exactly (pinned by
+tests/test_fused_fit.py): the same ``_solve_block`` / ``_run_impl``
+primitives are inlined by jit-in-jit tracing, warm starts enter as traced
+table operands, and regularization weights stay traced so a config-grid
+sweep (GameEstimator.scala:452-468 warm-start ladder) re-enters the SAME
+executable with new lambdas.
+
+Eligibility (``fuse_eligible``): single device (collectives stay on the
+serialized unfused path), no validation-driven best-model tracking, lazy
+random-effect datasets, no down-sampling (its per-iteration reseeding is
+host-driven). Everything else falls back to ``CoordinateDescent``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from photon_tpu.algorithm.coordinate import (
+    FixedEffectCoordinate,
+    ModelCoordinate,
+)
+from photon_tpu.algorithm.coordinate_descent import (
+    CoordinateDescentResult,
+    CoordinateUpdateRecord,
+)
+from photon_tpu.algorithm.problems import (
+    VarianceComputationType,
+    _run_impl,
+)
+from photon_tpu.algorithm.random_effect import (
+    RandomEffectCoordinate,
+    RandomEffectTrainingStats,
+    _solve_block,
+)
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+    _bucket_score_add,
+    _passive_score_set_dense,
+    _passive_score_set_sparse,
+    score_raw_features,
+)
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+
+Array = jax.Array
+
+
+class _PackedDiags:
+    """All per-update diagnostic arrays of one fused fit, packed into ONE
+    int32 device buffer — a host pull costs a ~100ms round trip on the
+    tunneled backend, so six per-coordinate arrays would cost more than
+    the fit's dispatch. Pulled lazily, once, on first diagnostic access."""
+
+    def __init__(self, flat: Array, shapes: list[tuple]):
+        self._flat = flat
+        self._shapes = shapes
+        self._arrays: list[np.ndarray] | None = None
+
+    def get(self, index: int) -> np.ndarray:
+        if self._arrays is None:
+            flat = np.asarray(self._flat)
+            self._arrays = []
+            o = 0
+            for shape in self._shapes:
+                size = int(np.prod(shape))
+                self._arrays.append(flat[o:o + size].reshape(shape))
+                o += size
+            self._flat = None
+        return self._arrays[index]
+
+
+class FusedFixedEffectStats:
+    """Per-update fixed-effect diagnostics from the fused program.
+
+    Mirrors the OptimizationResult attributes the reporting/bench layer
+    reads (iterations, convergence_reason); values pull lazily through the
+    packed diagnostics buffer."""
+
+    def __init__(self, packed: _PackedDiags, it_index: int, rs_index: int,
+                 iteration: int):
+        self._packed = packed
+        self._it_index = it_index
+        self._rs_index = rs_index
+        self._iteration = iteration
+
+    @property
+    def iterations(self) -> int:
+        return int(self._packed.get(self._it_index)[self._iteration])
+
+    @property
+    def convergence_reason(self) -> int:
+        return int(self._packed.get(self._rs_index)[self._iteration])
+
+
+def fuse_eligible(coords: dict[str, object]) -> bool:
+    """True when every coordinate can ride the single-program fit."""
+    for coord in coords.values():
+        if isinstance(coord, ModelCoordinate):
+            continue
+        inner = getattr(coord, "inner", coord)
+        if isinstance(inner, FixedEffectCoordinate):
+            rate = inner.config.down_sampling_rate
+            if 0.0 < rate < 1.0:
+                return False
+            if inner.config.optimizer.box_constraints is not None:
+                return False  # untraced path (trace constants)
+            if (inner.logical_rows is not None
+                    and inner.batch.num_samples != inner.logical_rows):
+                return False  # padded mesh batches stay unfused
+            if getattr(inner.batch.features, "logical_d", None) is not None:
+                return False  # column-sharded solve: mesh path
+        elif isinstance(inner, RandomEffectCoordinate):
+            if not inner.dataset.is_lazy:
+                return False  # materialized score tables: legacy path
+        else:
+            return False
+    return True
+
+
+def _re_statics(coord: RandomEffectCoordinate) -> dict:
+    """Static solver routing for one RE coordinate (mirrors
+    RandomEffectCoordinate._dispatch_block's well-posedness analysis)."""
+    from photon_tpu.types import TaskType
+
+    cfg = coord.config
+    well_posed = (
+        cfg.l1_weight == 0.0
+        and cfg.l2_weight > 0.0
+        and cfg.optimizer.box_constraints is None
+        and (coord.prior is None or cfg.incremental_weight > 0.0)
+    )
+    direct = well_posed and coord.task == TaskType.LINEAR_REGRESSION
+    newton = well_posed and coord.task in (
+        TaskType.LOGISTIC_REGRESSION, TaskType.POISSON_REGRESSION
+    )
+    return dict(
+        task=coord.task,
+        opt_config=cfg.optimizer,
+        use_owlqn=cfg.l1_weight != 0.0,
+        variance_computation=cfg.variance_computation,
+        direct=direct,
+        newton=newton,
+    )
+
+
+def fused_static_key(coords: dict, seq: list[str], num_iterations: int,
+                     locked: set[str]) -> tuple:
+    """Hashable descriptor of everything baked into the fused trace.
+
+    Initial models are NOT part of the key: warm-start tables are always
+    operands (zeros when absent), so their presence never changes the
+    traced structure."""
+    parts: list = [tuple(seq), num_iterations, tuple(sorted(locked))]
+    for cid in seq:
+        coord = coords[cid]
+        if isinstance(coord, ModelCoordinate):
+            parts.append((cid, "locked"))
+            continue
+        inner = getattr(coord, "inner", coord)
+        if isinstance(inner, FixedEffectCoordinate):
+            cfg = inner.config
+            parts.append((
+                cid, "fixed", inner.problem.task, cfg.optimizer,
+                cfg.l1_weight != 0.0, cfg.variance_computation,
+                inner.problem.intercept_index,
+                inner.problem.prior is not None,
+                inner.problem.normalization.factors is not None,
+                inner.problem.normalization.shifts is not None,
+                inner.batch.num_samples, inner.batch.num_features,
+            ))
+        else:
+            ds = inner.dataset
+            st = _re_statics(inner)
+            parts.append((
+                cid, "random", st["task"], st["opt_config"],
+                st["use_owlqn"], st["variance_computation"], st["direct"],
+                st["newton"], inner.prior is not None,
+                inner.normalization.factors is not None,
+                inner.normalization.shifts is not None,
+                ds.num_entities, ds.max_sub_dim,
+                tuple(
+                    (b.row_ids.shape, b.proj.shape) for b in ds.blocks
+                ),
+            ))
+    return tuple(parts)
+
+
+class FusedFit:
+    """One estimator-generation's compiled whole-fit program.
+
+    Built from a coords dict (the first config's); ``run`` re-assembles
+    traced operands from the CURRENT coords, so later configs in a grid
+    (same structure, new lambdas) reuse the compiled executable.
+    """
+
+    def __init__(
+        self,
+        coords: dict[str, object],
+        update_sequence: list[str],
+        num_iterations: int,
+        locked_coordinates: set[str] | None = None,
+    ):
+        self.seq = list(update_sequence)
+        self.num_iterations = num_iterations
+        self.locked = set(locked_coordinates or ())
+        self.kinds: dict[str, str] = {}
+        self._re_meta: dict[str, dict] = {}
+        for cid in self.seq:
+            coord = coords[cid]
+            if isinstance(coord, ModelCoordinate) or cid in self.locked:
+                self.kinds[cid] = "locked"
+                continue
+            inner = getattr(coord, "inner", coord)
+            if isinstance(inner, FixedEffectCoordinate):
+                self.kinds[cid] = "fixed"
+            else:
+                self.kinds[cid] = "random"
+                ds = inner.dataset
+                keep = np.zeros(ds.num_entities, bool)
+                for codes in ds.block_codes_np:
+                    real = codes[codes < ds.num_entities]
+                    keep[real] = True
+                _, passive = ds.covered_row_partition()
+                self._re_meta[cid] = {
+                    "keep": keep,
+                    "passive": passive if passive.size else None,
+                }
+        # FE normalization contexts ride as trace-time constants: the
+        # factor/shift arrays are tiny [d] vectors fixed per estimator
+        # generation, and embedding them keeps _run_impl's static
+        # specialization (None factors -> raw fast path) intact.
+        self._norms = []
+        for cid in self.seq:
+            inner = getattr(coords[cid], "inner", coords[cid])
+            self._norms.append(
+                inner.problem.normalization
+                if isinstance(inner, FixedEffectCoordinate) else None
+            )
+        self._jit = jax.jit(self._fit_fn, static_argnames=("statics",))
+        # Slab materialization runs ONCE per dataset generation as its own
+        # single program (every bucket of every RE coordinate together);
+        # its outputs feed the fit program as plain operands. Folding it
+        # into the fit would re-gather ~0.4s of slabs on every repeated
+        # fit; leaving it per-bucket (the unfused device_blocks() path)
+        # costs one compile round trip per bucket on a remote backend.
+        self._mat_jit = jax.jit(
+            lambda plans: tuple(
+                tuple(p.materialize(None) for p in pl) for pl in plans
+            )
+        )
+        self._mat_cache: tuple | None = None
+        # Zero warm-start tables, created once per generation: an eager
+        # jnp.zeros([100k, S]) costs a ~250ms device round trip on the
+        # tunneled backend, which would otherwise recur on every fit.
+        self._zeros_cache: dict[tuple, Array] = {}
+        self.static_key = None  # set by the estimator cache
+
+    # ------------------------------------------------------------------
+    # operand assembly (per run; cheap)
+    # ------------------------------------------------------------------
+
+    def _zeros(self, shape, dtype) -> Array:
+        key = (shape, jnp.dtype(dtype).name)
+        z = self._zeros_cache.get(key)
+        if z is None:
+            z = jnp.zeros(shape, dtype)
+            self._zeros_cache[key] = z
+        return z
+
+    def _operands(self, coords, initial_models):
+        ops = []
+        for cid in self.seq:
+            coord = coords[cid]
+            kind = self.kinds[cid]
+            if kind == "locked":
+                # Locked (partial-retrain) coordinates are score-only;
+                # their model comes from initial_models exactly as in the
+                # unfused CoordinateDescent (locked ids must come with a
+                # model). Scoring runs eagerly — once per run, through the
+                # coordinate's own jitted scorer.
+                if isinstance(coord, ModelCoordinate):
+                    z = coord.score()
+                else:
+                    if not initial_models or cid not in initial_models:
+                        raise KeyError(
+                            f"locked coordinate {cid!r} requires a model "
+                            "in initial_models "
+                            "(partialRetrainLockedCoordinates)")
+                    z = coord.score(initial_models[cid])
+                ops.append({"z": z})
+                continue
+            inner = getattr(coord, "inner", coord)
+            if kind == "fixed":
+                dtype = inner.batch.labels.dtype
+                d = inner.batch.num_features
+                init = None
+                if initial_models and cid in initial_models:
+                    m = initial_models[cid]
+                    glm = m.model if hasattr(m, "model") else m
+                    # padded_to covers models loaded with fewer features
+                    # than the batch (the unfused FixedEffectCoordinate
+                    # .train does the same before solving).
+                    init = jnp.asarray(
+                        glm.coefficients.padded_to(d).means, dtype=dtype)
+                prior = None
+                if inner.problem.prior is not None:
+                    p = inner.problem.prior.padded_to(d)
+                    prior = (jnp.asarray(p.means, dtype=dtype),
+                             jnp.asarray(p.variances, dtype=dtype))
+                cfg = inner.config
+                ops.append({
+                    "batch": inner.batch,
+                    "w0": (init if init is not None
+                           else self._zeros((d,), dtype)),
+                    "l1": np.asarray(cfg.l1_weight, dtype=dtype),
+                    "l2": np.asarray(cfg.l2_weight, dtype=dtype),
+                    "iw": np.asarray(cfg.incremental_weight, dtype=dtype),
+                    "prior": prior,
+                })
+            else:
+                ds = inner.dataset
+                dtype = jnp.dtype(ds.dtype)
+                w0 = None
+                if initial_models and cid in initial_models:
+                    w0 = initial_models[cid].coefficients
+                cfg = inner.config
+                prior = None
+                if inner.prior is not None:
+                    prior = (inner.prior.coefficients,
+                             inner.prior.variances)
+                meta = self._re_meta[cid]
+                ops.append({
+                    "blocks": tuple(ds.blocks),
+                    "w0": (w0 if w0 is not None else self._zeros(
+                        (ds.num_entities, ds.max_sub_dim), dtype)),
+                    "l1": np.asarray(cfg.l1_weight, dtype=dtype),
+                    "l2": np.asarray(cfg.l2_weight, dtype=dtype),
+                    "iw": np.asarray(cfg.incremental_weight, dtype=dtype),
+                    "prior": prior,
+                    "factors": inner.normalization.factors,
+                    "shifts": inner.normalization.shifts,
+                    "score_codes": ds.score_codes,
+                    "raw": ds.raw,
+                    "proj_dev": ds.proj_dev,
+                    "passive": (None if meta["passive"] is None
+                                else jnp.asarray(meta["passive"])),
+                })
+        return tuple(ops)
+
+    def _statics(self, coords, initial_models) -> tuple:
+        st = []
+        for cid in self.seq:
+            kind = self.kinds[cid]
+            # has_init gates the in-program scoring of the warm-start
+            # tables: scoring all-zero tables would waste passes on every
+            # cold fit (trailing element, read as st[-1]).
+            has_init = bool(initial_models and cid in initial_models)
+            if kind == "locked":
+                st.append(("locked",))
+                continue
+            inner = getattr(coords[cid], "inner", coords[cid])
+            if kind == "fixed":
+                cfg = inner.config
+                st.append((
+                    "fixed", inner.problem.task, cfg.optimizer,
+                    cfg.l1_weight != 0.0, inner.problem.intercept_index,
+                    cfg.variance_computation, has_init,
+                ))
+            else:
+                s = _re_statics(inner)
+                st.append((
+                    "random", s["task"], s["opt_config"], s["use_owlqn"],
+                    s["variance_computation"], s["direct"], s["newton"],
+                    has_init,
+                ))
+        return tuple(st)
+
+    # ------------------------------------------------------------------
+    # the traced program
+    # ------------------------------------------------------------------
+
+    def _re_score(self, w, op, ebs):
+        """Model contribution per canonical row (active+passive), traced.
+
+        Mirrors models/game.py _score_via_buckets with operand arrays."""
+        from photon_tpu.data.dataset import DenseFeatures
+
+        n = op["score_codes"].shape[0]
+        if any(eb.x_indices is not None for eb in ebs):
+            # ELL fallback bucket present: score straight off the raw shard.
+            return score_raw_features(
+                w, op["score_codes"], op["raw"], op["proj_dev"])
+        z = jnp.zeros(n, dtype=w.dtype)
+        for plan, eb in zip(op["blocks"], ebs):
+            z = _bucket_score_add(
+                z, eb.x_values, plan.row_ids, plan.row_counts,
+                plan.entity_codes, w,
+            )
+        if op["passive"] is not None:
+            pr = op["passive"]
+            if isinstance(op["raw"], DenseFeatures):
+                z = _passive_score_set_dense(
+                    z, pr, op["score_codes"], op["raw"].x, w,
+                    op["proj_dev"])
+            else:
+                z = _passive_score_set_sparse(
+                    z, pr, op["score_codes"], op["raw"].indices,
+                    op["raw"].values, w, op["proj_dev"])
+        return z
+
+    def _fe_score(self, means, batch):
+        return Coefficients(means=means).compute_score(batch.features)
+
+    def _fit_fn(self, ops, ebs_all, *, statics):
+        num_iters = self.num_iterations
+
+        # --- initial state ------------------------------------------------
+        states: list = []
+        scores: list = []
+        diags: list = []
+        total = None
+        for i, (op, st) in enumerate(zip(ops, statics)):
+            kind = st[0]
+            if kind == "locked":
+                states.append(())
+                scores.append(op["z"])
+                diags.append(())
+            elif kind == "fixed":
+                means = op["w0"]
+                has_init = st[-1]
+                variances = (
+                    None
+                    if st[5] == VarianceComputationType.NONE
+                    else jnp.zeros_like(means)
+                )
+                states.append((means, variances))
+                scores.append(
+                    self._fe_score(means, op["batch"]) if has_init
+                    else jnp.zeros(
+                        op["batch"].num_samples, means.dtype)
+                )
+                diags.append((
+                    jnp.zeros(num_iters, jnp.int32),
+                    jnp.zeros(num_iters, jnp.int32),
+                ))
+            else:
+                w_all = op["w0"]
+                has_init = st[-1]
+                e = w_all.shape[0]
+                v_all = (
+                    None
+                    if st[4] == VarianceComputationType.NONE
+                    else jnp.zeros_like(w_all)
+                )
+                states.append((w_all, v_all))
+                scores.append(
+                    self._re_score(w_all, op, ebs_all[i]) if has_init
+                    else jnp.zeros(
+                        op["score_codes"].shape[0], w_all.dtype)
+                )
+                diags.append((
+                    jnp.zeros((num_iters, e), jnp.int32),
+                    jnp.zeros((num_iters, e), jnp.int32),
+                ))
+            total = scores[-1] if total is None else total + scores[-1]
+
+        def sweep(it, carry):
+            states, scores, total, diags = carry
+            states = list(states)
+            scores = list(scores)
+            diags = list(diags)
+            for i, (op, st) in enumerate(zip(ops, statics)):
+                kind = st[0]
+                if kind == "locked":
+                    continue
+                residual = total - scores[i]
+                if kind == "fixed":
+                    _, task, opt_config, use_owlqn, intercept_index, \
+                        var_comp = st[:6]
+                    batch = op["batch"]
+                    batch = batch.with_offsets(batch.offsets + residual)
+                    means, variances, result = _run_impl(
+                        batch,
+                        states[i][0],
+                        op["l1"], op["l2"],
+                        self._fe_norm(i),
+                        op["prior"],
+                        op["iw"],
+                        task=task,
+                        opt_config=opt_config,
+                        use_owlqn=use_owlqn,
+                        intercept_index=intercept_index,
+                        variance_computation=var_comp,
+                    )
+                    states[i] = (means, variances)
+                    z = self._fe_score(means, op["batch"])
+                    it_arr, rs_arr = diags[i]
+                    diags[i] = (
+                        it_arr.at[it].set(result.iterations),
+                        rs_arr.at[it].set(result.convergence_reason),
+                    )
+                else:
+                    _, task, opt_config, use_owlqn, var_comp, direct, \
+                        newton = st[:7]
+                    w_prev, v_prev = states[i]
+                    w_all = jnp.zeros_like(w_prev)
+                    v_all = None if v_prev is None else jnp.zeros_like(
+                        v_prev)
+                    e = w_prev.shape[0]
+                    its_e = jnp.zeros(e, jnp.int32)
+                    rs_e = jnp.zeros(e, jnp.int32)
+                    for plan, eb in zip(op["blocks"], ebs_all[i]):
+                        w_all, v_all, its, rs = _solve_block(
+                            eb,
+                            residual,
+                            op["factors"],
+                            op["shifts"],
+                            w_prev,
+                            op["l1"], op["l2"], op["iw"],
+                            op["prior"],
+                            w_all, v_all,
+                            sub_dim=eb.sub_dim,
+                            task=task,
+                            opt_config=opt_config,
+                            use_owlqn=use_owlqn,
+                            variance_computation=var_comp,
+                            direct=direct,
+                            newton=newton,
+                        )
+                        its_e = its_e.at[plan.entity_codes].set(its)
+                        rs_e = rs_e.at[plan.entity_codes].set(rs)
+                    states[i] = (w_all, v_all)
+                    z = self._re_score(w_all, op, ebs_all[i])
+                    it_arr, rs_arr = diags[i]
+                    diags[i] = (
+                        it_arr.at[it].set(its_e),
+                        rs_arr.at[it].set(rs_e),
+                    )
+                total = total - scores[i] + z
+                scores[i] = z
+            return tuple(states), tuple(scores), total, tuple(diags)
+
+        carry = (tuple(states), tuple(scores), total, tuple(diags))
+        carry = lax.fori_loop(0, num_iters, sweep, carry)
+        states, scores, total, diags = carry
+        # Pack every diagnostic array into ONE int32 buffer: a host pull
+        # costs a fixed round trip on remote backends, so one buffer beats
+        # 2 x n_coordinates of them (_PackedDiags splits host-side).
+        flat_parts = [
+            d.reshape(-1) for pair in diags for d in pair
+        ]
+        packed = (
+            jnp.concatenate(flat_parts) if flat_parts
+            else jnp.zeros(0, jnp.int32)
+        )
+        return states, scores, total, packed
+
+    def _fe_norm(self, i):
+        """NormalizationContext for coordinate i (host constant — factor
+        arrays are tiny [d] vectors; embedding them as program constants
+        is deliberate)."""
+        return self._norms[i]
+
+    # ------------------------------------------------------------------
+    # the public entry
+    # ------------------------------------------------------------------
+
+    def run(
+        self,
+        coords: dict[str, object],
+        initial_models: dict[str, object] | None = None,
+    ) -> CoordinateDescentResult:
+        t0 = time.perf_counter()
+        ops = self._operands(coords, initial_models)
+        statics = self._statics(coords, initial_models)
+        # Slabs materialize once per dataset generation (separate cached
+        # program); every fit's program receives them as plain operands.
+        if self._mat_cache is None:
+            plans = tuple(
+                op["blocks"] if st[0] == "random" else ()
+                for op, st in zip(ops, statics)
+            )
+            self._mat_cache = self._mat_jit(plans)
+        ebs_all = self._mat_cache
+        states, scores, total, packed_flat = self._jit(
+            ops, ebs_all, statics=statics)
+        # Diagnostic shapes, in the exact flattening order of _fit_fn's
+        # packing; indices into _PackedDiags per coordinate.
+        shapes: list[tuple] = []
+        diag_index: dict[str, tuple[int, int]] = {}
+        t = self.num_iterations
+        for i, cid in enumerate(self.seq):
+            kind = self.kinds[cid]
+            if kind == "locked":
+                continue
+            if kind == "fixed":
+                shape = (t,)
+            else:
+                e = ops[i]["w0"].shape[0]
+                shape = (t, e)
+            diag_index[cid] = (len(shapes), len(shapes) + 1)
+            shapes.extend([shape, shape])
+        packed = _PackedDiags(packed_flat, shapes)
+
+        models: dict[str, object] = {}
+        history: list[CoordinateUpdateRecord] = []
+        seconds = time.perf_counter() - t0
+        n_updates = max(
+            1,
+            self.num_iterations
+            * sum(1 for c in self.seq if self.kinds[c] != "locked"),
+        )
+        per_update = seconds / n_updates
+        for i, cid in enumerate(self.seq):
+            coord = coords[cid]
+            kind = self.kinds[cid]
+            if kind == "locked":
+                models[cid] = (
+                    coord.model if isinstance(coord, ModelCoordinate)
+                    else initial_models[cid]
+                )
+                continue
+            inner = getattr(coord, "inner", coord)
+            if kind == "fixed":
+                means, variances = states[i]
+                glm = GeneralizedLinearModel(
+                    Coefficients(means=means, variances=variances),
+                    inner.problem.task,
+                )
+                models[cid] = FixedEffectModel(
+                    glm, coords[cid].feature_shard_id)
+            else:
+                ds = inner.dataset
+                w_all, v_all = states[i]
+                models[cid] = RandomEffectModel(
+                    coefficients=w_all,
+                    random_effect_type=ds.config.random_effect_type,
+                    feature_shard_id=ds.config.feature_shard_id,
+                    task=inner.task,
+                    proj_all=ds.proj_all,
+                    variances=v_all,
+                    entity_keys=ds.entity_keys,
+                )
+        for it in range(self.num_iterations):
+            for i, cid in enumerate(self.seq):
+                kind = self.kinds[cid]
+                if kind == "locked":
+                    continue
+                it_idx, rs_idx = diag_index[cid]
+                if kind == "fixed":
+                    diag = FusedFixedEffectStats(packed, it_idx, rs_idx, it)
+                else:
+                    keep = self._re_meta[cid]["keep"]
+                    diag = RandomEffectTrainingStats.from_thunk(
+                        lambda packed=packed, it_idx=it_idx,
+                        rs_idx=rs_idx, it=it, keep=keep: (
+                            packed.get(rs_idx)[it][keep],
+                            packed.get(it_idx)[it][keep],
+                        )
+                    )
+                history.append(CoordinateUpdateRecord(
+                    iteration=it,
+                    coordinate_id=cid,
+                    seconds=per_update,
+                    diagnostics=diag,
+                    evaluation=None,
+                ))
+        final = GameModel(dict(models))
+        return CoordinateDescentResult(
+            model=final,
+            best_model=final,
+            best_evaluation=None,
+            history=tuple(history),
+        )

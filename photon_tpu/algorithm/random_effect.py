@@ -76,12 +76,16 @@ class RandomEffectTrainingStats:
     explicit release hook is needed.
     """
 
-    def __init__(self, reasons=None, iterations=None, *, device=None):
+    def __init__(self, reasons=None, iterations=None, *, device=None,
+                 thunk=None):
         # device: (reason device arrays, iteration device arrays,
         #          host keep-masks) — one pull on first attribute access.
+        # thunk: zero-arg callable -> (reasons np, iterations np); the
+        #        fused fit's packed-diagnostics buffer resolves through it.
         self._device = device
+        self._thunk = thunk
         self._host = None
-        if device is None:
+        if device is None and thunk is None:
             self._host = (
                 np.asarray(reasons) if reasons is not None
                 else np.empty(0, np.int32),
@@ -99,7 +103,15 @@ class RandomEffectTrainingStats:
             device=(reason_arrays, iteration_arrays, keep_masks)
         )
 
+    @staticmethod
+    def from_thunk(thunk):
+        return RandomEffectTrainingStats(thunk=thunk)
+
     def _materialize(self):
+        if self._host is None and self._thunk is not None:
+            reasons, iters = self._thunk()
+            self._host = (np.asarray(reasons), np.asarray(iters))
+            self._thunk = None
         if self._host is None:
             reasons_d, iters_d, keeps = self._device
             keep = np.concatenate(keeps) if keeps else np.empty(0, bool)
@@ -184,7 +196,8 @@ def _densify_ell_slots(
     return jnp.einsum("...k,...ks->...s", x_values, onehot)
 
 
-def _spd_solve_cg(h: Array, b: Array, sub_dim: int) -> Array:
+def _spd_solve_cg(h: Array, b: Array, sub_dim: int,
+                  refine: bool = True) -> Array:
     """Solve the SPD system ``h x = b`` by FIXED-count conjugate gradients.
 
     Batched tiny Cholesky/triangular solves lower to sequential scalar
@@ -195,10 +208,13 @@ def _spd_solve_cg(h: Array, b: Array, sub_dim: int) -> Array:
     small by construction (LinearSubspaceProjector compression).
 
     In float32 S-step CG is NOT backward-stable on ill-conditioned H
-    (relative error ~0.5 at cond(H)=1e4 measured), so one round of
-    iterative refinement follows: ``x += cg(H, b - H x)``. Both passes are
-    the same batched GEMM shapes; the refined solve tracks a direct fp32
-    Cholesky down to cond(H)~1e6.
+    (relative error ~0.5 at cond(H)=1e4 measured), so with ``refine`` one
+    round of iterative refinement follows: ``x += cg(H, b - H x)``. Both
+    passes are the same batched GEMM shapes; the refined solve tracks a
+    direct fp32 Cholesky down to cond(H)~1e6. Newton DIRECTION solves pass
+    ``refine=False`` — directions only need descent (enforced by the
+    g.d < 0 steepest-descent fallback at the call site), and refinement
+    would double the sequential depth of the latency-bound hot loop.
     """
 
     def run_cg(rhs):
@@ -217,6 +233,8 @@ def _spd_solve_cg(h: Array, b: Array, sub_dim: int) -> Array:
         return x
 
     x = run_cg(b)
+    if not refine:
+        return x
     return x + run_cg(b - h @ x)
 
 
@@ -340,6 +358,256 @@ def _materialize_transformed_design(
 _NEWTON_LINE_SEARCH_HALVINGS = 15
 
 
+def _spd_solve_cg_sb(h_sb: Array, b_sb: Array, sub_dim: int,
+                     active: Array) -> Array:
+    """Batched SPD solve in BATCH-MINOR layout: ``h_sb`` is [S, S, B] and
+    ``b_sb``/result are [S, B].
+
+    Why the layout matters: a vmapped per-entity CG carries H as [B, S, S]
+    and state as [B, S]; with S ~ 17 the TPU's (8, 128) tiling pads the
+    minor axis 17 -> 128, physically inflating every CG-step re-read of H
+    ~7-10x (the dominant HBM traffic of the whole per-entity solve,
+    measured by the round-4 Pallas probe, experiments/README.md). With B
+    minor, lanes are dense: H is stored compact and each of the S CG steps
+    is elementwise-over-B multiply-reduce work at full lane utilization.
+
+    ``active`` [B] masks converged entities: their iterates are frozen so
+    a diverging stale system cannot produce NaNs that poison the batch.
+    """
+
+    def cg_step(_, state):
+        x, r, p, rs = state
+        # Broadcast-multiply-reduce, NOT einsum/dot_general: the batched
+        # contraction with minor batch dim lowers to per-row slice chains
+        # (~3 x 0.7ms per CG step measured), while this form fuses into
+        # one elementwise+reduce kernel over the compact [S, S, B] block.
+        hp = jnp.sum(h_sb * p[None, :, :], axis=1)
+        denom = jnp.sum(p * hp, axis=0)
+        alpha = jnp.where(active, rs / jnp.maximum(denom, 1e-30), 0.0)
+        x = x + alpha[None, :] * p
+        r = r - alpha[None, :] * hp
+        rs_new = jnp.sum(r * r, axis=0)
+        beta = rs_new / jnp.maximum(rs, 1e-30)
+        p = r + jnp.where(active, beta, 0.0)[None, :] * p
+        return x, r, p, rs_new
+
+    init = (jnp.zeros_like(b_sb), b_sb, b_sb,
+            jnp.sum(b_sb * b_sb, axis=0))
+    x, _, _, _ = lax.fori_loop(0, sub_dim, cg_step, init)
+    return x
+
+
+def _solve_newton_batched(
+    x: Array,  # [B, R, S] dense slab (raw, untransformed)
+    labels: Array,  # [B, R]
+    offsets: Array,  # [B, R]
+    weights: Array,  # [B, R]
+    penalty_mask: Array,  # [B, S]
+    valid_mask: Array,  # [B, S]
+    factors: Array | None,  # [B, S]
+    shifts: Array | None,  # [B, S]
+    intercept_slots: Array,  # [B]
+    w0_orig: Array,  # [B, S]
+    prior: tuple[Array, Array] | None,  # ([B, S], [B, S])
+    *,
+    sub_dim: int,
+    task: TaskType,
+    opt_config: optim.OptimizerConfig,
+    variance_computation: VarianceComputationType,
+    l2_weight: Array,
+    incremental_weight: Array,
+):
+    """Batch-level damped-Newton/IRLS for a whole dense bucket.
+
+    Numerically the batched transcription of ``_solve_one_entity_newton``
+    (same objective, same one-pass Armijo trials, same convergence
+    cascade), written WITHOUT vmap so the Hessians and CG state can live
+    in batch-minor layout (see ``_spd_solve_cg_sb``): the [B, S, S] MXU
+    Hessian batch is transposed ONCE to compact [S, S, B] instead of being
+    re-read S times through a 7-10x tiling-padded layout. The Newton
+    direction uses a single S-step CG (no refinement pass — directions
+    only need descent, which the g.d < 0 guard enforces; the refined
+    solver stays on the exact direct path where the solution itself is
+    the answer).
+    """
+    dtype = x.dtype
+    b = x.shape[0]
+    if shifts is not None:
+        x = x - shifts[:, None, :]
+    if factors is not None:
+        x = x * factors[:, None, :]
+    loss = losses_mod.get_loss(task)
+    iota = jnp.arange(sub_dim)[None, :]
+    int_onehot = (
+        None if shifts is None
+        else (iota == intercept_slots[:, None]).astype(dtype)
+    )
+
+    def to_transformed(w):
+        if shifts is not None:
+            w = w + jnp.sum(w * shifts, axis=-1, keepdims=True) * int_onehot
+        if factors is not None:
+            w = w / factors
+        return w
+
+    def to_original(w_t):
+        w = w_t if factors is None else w_t * factors
+        if shifts is not None:
+            w = w - jnp.sum(w * shifts, axis=-1, keepdims=True) * int_onehot
+        return w
+
+    if prior is not None:
+        m_t = to_transformed(prior[0])
+        f_sq = 1.0 if factors is None else factors * factors
+        inv_prior_var = optim.inverse_prior_variances(
+            prior[1] / f_sq, l2_weight) * valid_mask
+        l2_diag = incremental_weight * inv_prior_var
+    else:
+        m_t = jnp.zeros((b, sub_dim), dtype)
+        l2_diag = l2_weight * penalty_mask
+
+    def objective(w):  # w [B, S] -> f [B], g [B, S]
+        z = jnp.einsum("brs,bs->br", x, w) + offsets
+        f = jnp.sum(weights * loss.loss(z, labels), axis=-1) + 0.5 * jnp.sum(
+            l2_diag * (w - m_t) ** 2, axis=-1
+        )
+        g = jnp.einsum("brs,br->bs", x, weights * loss.dz(z, labels))
+        g = g + l2_diag * (w - m_t)
+        return f, g * valid_mask
+
+    # Per-entity absolute tolerances from the zero state
+    # (Optimizer.scala:167-170 semantics, batched).
+    f0z, g0z = objective(jnp.zeros((b, sub_dim), dtype))
+    tol = optim.Tolerances(
+        loss_abs=jnp.abs(f0z) * opt_config.tolerance,
+        gradient_abs=jnp.sqrt(jnp.sum(g0z * g0z, axis=-1))
+        * opt_config.tolerance,
+    )
+    w0 = to_transformed(w0_orig) * valid_mask
+    f0, g0 = objective(w0)
+    max_iters = opt_config.max_iterations
+    trial_ts = 0.5 ** jnp.arange(
+        _NEWTON_LINE_SEARCH_HALVINGS + 1, dtype=dtype
+    )  # [T]
+
+    def cond(s):
+        _, _, _, _, code = s
+        return jnp.any(code == 0)
+
+    def body(s):
+        w, f, g, it, code = s
+        active = code == 0
+        z = jnp.einsum("brs,bs->br", x, w) + offsets
+        curvature = weights * loss.dzz(z, labels)
+        h = jnp.einsum("brs,brt->bst", x * curvature[:, :, None], x)
+        h = h + (
+            l2_diag[:, :, None] * jnp.eye(sub_dim, dtype=dtype)[None]
+            + (1.0 - valid_mask)[:, :, None]
+            * jnp.eye(sub_dim, dtype=dtype)[None]
+        )
+        # ONE compact transpose; CG then re-reads the dense [S, S, B]
+        # copy instead of the tiling-padded MXU output.
+        h_sb = jnp.transpose(h, (1, 2, 0))
+        d = jnp.transpose(
+            _spd_solve_cg_sb(h_sb, -jnp.transpose(g), sub_dim, active)
+        ) * valid_mask
+        gd = jnp.sum(g * d, axis=-1)
+        # Descent guard (same as the vmapped path): fp32 CG on a
+        # near-singular Hessian can return a non-descent direction.
+        bad = gd >= 0.0
+        d = jnp.where(bad[:, None], -g, d)
+        gd = jnp.where(bad, -jnp.sum(g * g, axis=-1), gd)
+
+        zd = jnp.einsum("brs,bs->br", x, d)
+        z_t = z[None] + trial_ts[:, None, None] * zd[None]  # [T, B, R]
+        w_t_trials = w[None] + trial_ts[:, None, None] * d[None]  # [T,B,S]
+        f_t = jnp.sum(
+            weights[None] * loss.loss(z_t, labels[None]), axis=-1
+        ) + 0.5 * jnp.sum(
+            l2_diag[None] * (w_t_trials - m_t[None]) ** 2, axis=-1
+        )  # [T, B]
+        armijo = f_t <= f[None] + 1e-4 * trial_ts[:, None] * gd[None]
+        first = jnp.argmax(armijo, axis=0)  # [B]
+        any_ok = jnp.any(armijo, axis=0)
+        t = trial_ts[first]
+        f_t_sel = jnp.take_along_axis(f_t, first[None], axis=0)[0]
+        improved = any_ok & (f_t_sel < f)
+        step_ok = active & improved
+        w_new = jnp.where(step_ok[:, None], w + t[:, None] * d, w)
+        f_new, g_new = objective(w_new)
+        f_new = jnp.where(active, f_new, f)
+        g_new = jnp.where(active[:, None], g_new, g)
+        it_new = jnp.where(active, it + 1, it)
+        code_new = optim.convergence_code(
+            iteration=it_new,
+            max_iterations=max_iters,
+            loss_delta=f - f_new,
+            gradient_norm=jnp.sqrt(jnp.sum(g_new * g_new, axis=-1)),
+            tol=tol,
+            not_improving=~improved,
+        )
+        code_new = jnp.where(active, code_new, code)
+        return w_new, f_new, g_new, it_new, code_new
+
+    w_t, f_fin, g_fin, iters, reason = lax.while_loop(
+        cond, body,
+        (w0, f0, g0, jnp.zeros(b, jnp.int32), jnp.zeros(b, jnp.int32)),
+    )
+    w_t = w_t * valid_mask
+
+    if variance_computation != VarianceComputationType.NONE:
+        variances = _batched_variances(
+            x, labels, offsets, weights, w_t, l2_diag, valid_mask,
+            factors, shifts, loss, variance_computation,
+        )
+    else:
+        variances = jnp.zeros_like(w_t)
+
+    w_orig = to_original(w_t) * valid_mask
+    return w_orig, variances, iters, reason
+
+
+def _batched_variances(x_t, labels, offsets, weights, w_t, l2_diag,
+                       valid_mask, factors, shifts, loss,
+                       variance_computation):
+    """Coefficient variances for a dense bucket, batched.
+
+    ``x_t`` is ALREADY the transformed design, so the Hessian diagonal /
+    full Hessian come from plain batched contractions (the vmapped
+    ``variances_in_transformed_space`` would re-apply normalization).
+    SIMPLE inverts the Hessian diagonal; FULL recovers the inverse
+    Hessian's diagonal with one refined batch-minor CG per basis vector.
+    """
+    z = jnp.einsum("brs,bs->br", x_t, w_t) + offsets
+    curv = weights * loss.dzz(z, labels)
+    f_sq = 1.0 if factors is None else factors * factors
+    h_diag = jnp.einsum("brs,br->bs", x_t * x_t, curv) + l2_diag
+    dead = h_diag == 0.0  # zero-support, zero-penalty slots: var = inf
+    if variance_computation == VarianceComputationType.SIMPLE:
+        var_t = 1.0 / jnp.where(dead, jnp.inf, h_diag)
+        return jnp.where(valid_mask > 0, var_t * f_sq, 0.0)
+    # FULL: diagonal of the inverse Hessian — one refined batch-minor CG
+    # per basis vector (refinement keeps fp32 accuracy at the direct
+    # path's level; variance columns are s tiny solves, not the hot loop).
+    s = w_t.shape[-1]
+    h = jnp.einsum("brs,brt->bst", x_t * curv[:, :, None], x_t)
+    h = h + l2_diag[:, :, None] * jnp.eye(s, dtype=x_t.dtype)[None]
+    h = h + dead[:, :, None] * jnp.eye(s, dtype=x_t.dtype)[None]
+    h_sb = jnp.transpose(h, (1, 2, 0))
+    active = jnp.ones(w_t.shape[0], bool)
+
+    def col(i, acc):
+        e = jnp.zeros((s, w_t.shape[0]), x_t.dtype).at[i].set(1.0)
+        sol = _spd_solve_cg_sb(h_sb, e, s, active)
+        res = e - jnp.sum(h_sb * sol[None, :, :], axis=1)
+        sol = sol + _spd_solve_cg_sb(h_sb, res, s, active)
+        return acc.at[:, i].set(sol[i])
+
+    var_t = lax.fori_loop(0, s, col, jnp.zeros_like(w_t))
+    var_t = jnp.where(dead, jnp.inf, var_t)
+    return jnp.where(valid_mask > 0, var_t * f_sq, 0.0)
+
+
 def _solve_one_entity_newton(
     x_indices: Array | None,  # [R, k] ELL slots, or None (dense layout)
     x_values: Array,  # [R, k] or [R, S]
@@ -429,9 +697,9 @@ def _solve_one_entity_newton(
         # Padding slots get a unit diagonal so the system stays PD;
         # their gradient is masked, so their step is 0.
         h = h + jnp.diag(l2_diag + (1.0 - valid_mask))
-        d = _spd_solve_cg(h, -g, sub_dim) * valid_mask
+        d = _spd_solve_cg(h, -g, sub_dim, refine=False) * valid_mask
         gd = jnp.dot(g, d)
-        # Refined fp32 CG can still return a non-descent direction on a
+        # Unrefined fp32 CG can return a non-descent direction on a
         # near-singular Hessian; Armijo would then reject every trial and
         # the loop would exit at a non-optimum. Fall back to steepest
         # descent for such iterations — guaranteed descent, and the next
@@ -716,6 +984,31 @@ def _solve_block(
         return _scatter_results(w_all, v_all, codes, w, v, it, reason)
 
     if newton:
+        if block.x_indices is None:
+            # Dense buckets take the batch-minor rewrite: compact [S,S,B]
+            # Hessians + dense-lane CG instead of the vmapped layout whose
+            # tiling-padded H re-reads dominated the solve's HBM traffic.
+            w, v, it, reason = _solve_newton_batched(
+                block.x_values,
+                block.labels,
+                offsets,
+                block.weights,
+                block.penalty_mask,
+                block.valid_mask,
+                factors_sub,
+                shifts_sub,
+                block.intercept_slots,
+                w0,
+                prior,
+                sub_dim=sub_dim,
+                task=task,
+                opt_config=opt_config,
+                variance_computation=variance_computation,
+                l2_weight=l2_weight,
+                incremental_weight=incremental_weight,
+            )
+            return _scatter_results(w_all, v_all, codes, w, v, it, reason)
+
         def newton_solver(xi, xv, lb, off, wt, pm, vm, f, sh, islot, w0_e,
                           prior_e):
             return _solve_one_entity_newton(

@@ -501,6 +501,39 @@ class GameEstimator:
                 f.result()
         self._primed_datasets = datasets
 
+    def _fused_for(self, coords, datasets):
+        """The whole-fit fused program for this coordinate structure, or
+        None when ineligible (mesh execution, listeners, down-sampling,
+        materialized datasets — see fused_fit.fuse_eligible).
+
+        Cached per (dataset generation, static structure): a lambda-grid
+        config sequence re-enters the SAME compiled executable with new
+        traced weights (the warm-start ladder of GameEstimator.scala
+        :452-468 with zero recompiles)."""
+        if self.resolve_mesh() is not None or self.emitter is not None:
+            return None
+        from photon_tpu.algorithm.fused_fit import (
+            FusedFit,
+            fuse_eligible,
+            fused_static_key,
+        )
+
+        if not fuse_eligible(coords):
+            return None
+        key = fused_static_key(
+            coords, self.update_sequence, self.num_iterations,
+            self.locked_coordinates,
+        )
+        cached = getattr(self, "_fused_cache", None)
+        if cached is not None and cached[0] == key and cached[1] is datasets:
+            return cached[2]
+        fused = FusedFit(
+            coords, self.update_sequence, self.num_iterations,
+            self.locked_coordinates,
+        )
+        self._fused_cache = (key, datasets, fused)
+        return fused
+
     def _build_validation(
         self,
         datasets: dict[str, object],
@@ -569,9 +602,11 @@ class GameEstimator:
         ):
             return cached[1]
         # Release the previous generation's datasets BEFORE building the
-        # new one — _primed_datasets would otherwise pin the old device
-        # arrays through the build (2x peak HBM).
+        # new one — _primed_datasets / the fused program's operand cache
+        # would otherwise pin the old device arrays through the build
+        # (2x peak HBM).
         self._primed_datasets = None
+        self._fused_cache = None
         self._fit_cache = None
         datasets = self._build_datasets(data, initial_model)
         val_ctx = (
@@ -648,7 +683,11 @@ class GameEstimator:
                 datasets, opt_configs, priors,
                 logical_rows=data.num_samples,
             )
-            if not primed:
+            fused = (
+                self._fused_for(coords, datasets)
+                if val_ctx is None else None
+            )
+            if fused is None and not primed:
                 self._prime_compilations(coords, datasets)
                 primed = True
             cd = CoordinateDescent(
@@ -683,10 +722,13 @@ class GameEstimator:
             # Injective seed spacing: CD uses seed+iteration internally, so
             # stride by num_iterations to keep down-sampling draws
             # independent across the lambda-config grid.
-            descent = cd.run(
-                coords, initial_models or None, val_ctx,
-                seed=i * self.num_iterations,
-            )
+            if fused is not None:
+                descent = fused.run(coords, initial_models or None)
+            else:
+                descent = cd.run(
+                    coords, initial_models or None, val_ctx,
+                    seed=i * self.num_iterations,
+                )
             full_config = {
                 cid: opt_configs.get(cid, self.coordinate_configs[cid].optimization)
                 for cid in self.update_sequence
