@@ -15,13 +15,22 @@ coordinate descent. Two task variants run:
 
 Per variant, phases are measured separately (the reference's Timed sections
 around prepareTrainingDatasets vs CoordinateDescent.run):
-- **ingest**: host-side dataset planning + packed plan transfer;
-- **compile**: the variant's own first fit (tracing + XLA compiles; the
-  estimator primes all programs concurrently; a persistent compilation
-  cache makes repeat processes much cheaper);
+- **ingest**: host-side dataset planning + the single packed plan-buffer
+  transfer;
+- **compile**: the variant's own first fit. The whole coordinate-descent
+  fit is ONE fused XLA program (algorithm/fused_fit.py) plus one slab
+  materialization program, so this is ~2 compiles, not ~20; a persistent
+  compilation cache makes repeat processes cheaper. ``warm_cache_e2e``
+  reports a complete second prepare+fit cycle on freshly built
+  identical-shape data in the same process — the daily-cadence rerun cost.
 - **train**: steady-state coordinate descent, measured as an AGGREGATE of
   repeated full fits until >= MIN_MEASURE_SECONDS of wall-clock accumulates
-  — no reported metric derives from a sub-100ms measurement.
+  — no reported metric derives from a sub-100ms measurement. Completion is
+  forced through an on-device checksum of every trained coefficient table
+  (jax dispatch is asynchronous and block_until_ready returns at enqueue
+  on the tunneled backend); the tables themselves stay on device, exactly
+  as production scoring consumes them — pulling all coefficient tables to
+  the host would add ~0.9s/fit of pure tunnel transfer to every number.
 
 Roofline accounting, per variant:
 - ``model_flops_per_sec``: analytic lower-bound count of USEFUL model FLOPs
@@ -41,14 +50,23 @@ HONESTY NOTES (all in the output line):
   wall-clock numbers anywhere (BASELINE.md), so this ratio's only valid use
   is cross-round movement; it does NOT measure the BASELINE.md north star
   (>= 4x vs Spark-on-16xA100 measured).
-- ``regressions`` lists any frozen per-round floor this run violates
-  (the repo's RMSE<1.697 discipline applied to wall-clock; floors are set
-  from round-4 cold-cache runs with ~2x headroom).
-
-The ``yahoo_music_*`` section is a REAL-DATA timed run: the reference's own
-Yahoo! Music Avro fixture (GameIntegTest/input/duplicateFeatures) trained as
-a 3-coordinate GLMix through the product estimator, with the frozen
-RMSE < 1.697 threshold (GameTrainingDriverIntegTest.scala:78-79).
+- ``vs_measured_sklearn`` is a MEASURED same-host external anchor: sklearn
+  LogisticRegression(lbfgs) on the identical fixed-effect data plus a
+  looped per-entity sklearn fit on a random sample of entities,
+  extrapolated linearly to all entities and multiplied by the CD sweep
+  count. The extrapolation (sample -> all entities) is the one estimated
+  part and is labeled as such (``sklearn_entities_sampled``).
+- ``regressions`` lists any frozen per-round floor this run violates.
+  Floors RATCHET: each is ~1.5x off the best value achieved in any round
+  so far (the previous 2x-headroom policy let an 11x compile regression
+  through in round 4).
+- ``yahoo_fixture_*`` is a SCHEMA-PARITY SMOKE TEST on the reference's own
+  6-record Yahoo! Music Avro fixture (GameIntegTest/input/
+  duplicateFeatures): it proves the reference's Avro layout trains
+  end-to-end through the product estimator and stays under the
+  GameTrainingDriverIntegTest RMSE threshold, and nothing more — 6 rows
+  validate formats, not model quality. The real-data quality anchor is
+  the ``a9a_*`` block (32,561 rows, held-out AUC).
 
 Prints exactly ONE JSON line.
 """
@@ -79,13 +97,16 @@ N_MOVIES = 20_000
 CD_ITERATIONS = 4
 MIN_MEASURE_SECONDS = 2.0
 
-# Per-round wall-clock floors (regression gate): frozen from round-4
-# cold-compile-cache runs with ~2x headroom. A violation appears in the
-# output's "regressions" list.
+# Per-round wall-clock floors (regression gate): RATCHETED to ~1.5x off
+# the best value achieved in rounds 1-5 (round-5 measurements: 7.8M train
+# rows/s, 1.68M ingest rows/s, ~90s cold first fit on the shared-compiler
+# tunnel). A violation appears in the output's "regressions" list. The
+# old policy (~2x headroom frozen at round 4) let an 11x compile
+# regression pass silently — these fail the bench instead.
 FLOORS = {
-    "logistic_rows_per_sec": 2.5e6,
-    "ingest_rows_per_sec": 150e3,
-    "logistic_compile_seconds_max": 400.0,
+    "logistic_rows_per_sec": 5.2e6,
+    "ingest_rows_per_sec": 1.1e6,
+    "logistic_compile_seconds_max": 150.0,
 }
 
 YAHOO_TRAIN = (
@@ -94,10 +115,10 @@ YAHOO_TRAIN = (
 )
 
 
-def build_data(task="linear"):
-    from photon_tpu.data.dataset import DenseFeatures
-    from photon_tpu.data.game_data import make_game_dataset
-
+def _synth_arrays(task="linear"):
+    """The MovieLens-shaped synthetic workload as raw numpy (shared by the
+    framework's ingest AND the measured sklearn baseline — identical data
+    by construction: same seed, same draws)."""
     rng = np.random.default_rng(20260729)
     x = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
     x[:, -1] = 1.0
@@ -120,7 +141,15 @@ def build_data(task="linear"):
             rng.uniform(size=N_ROWS) < 1.0 / (1.0 + np.exp(-0.5 * z))
         ).astype(np.float32)
     else:
-        y = z + 0.2 * rng.normal(size=N_ROWS).astype(np.float32)
+        y = (z + 0.2 * rng.normal(size=N_ROWS)).astype(np.float32)
+    return x, xu, xm, users, movies, y
+
+
+def build_data(task="linear"):
+    from photon_tpu.data.dataset import DenseFeatures
+    from photon_tpu.data.game_data import make_game_dataset
+
+    x, xu, xm, users, movies, y = _synth_arrays(task)
     # Numpy-backed shards: make_game_dataset pushes the device copy once and
     # keeps host mirrors for the (host-side) dataset-build planner.
     return make_game_dataset(
@@ -132,6 +161,73 @@ def build_data(task="linear"):
         },
         id_tags={"userId": users, "movieId": movies},
     )
+
+
+def run_sklearn_baseline(our_per_fit_seconds: float) -> dict:
+    """MEASURED same-host external anchor (sklearn, CPU).
+
+    Measures on the IDENTICAL logistic workload:
+    - one full fixed-effect LogisticRegression(lbfgs) fit on all 4M x 64
+      rows;
+    - per-entity LogisticRegression fits on a random sample of users and
+      movies (their actual row subsets), timed per entity.
+
+    A GLMix block-coordinate sweep solves the fixed effect once plus every
+    per-entity subproblem, CD_ITERATIONS times; the estimate below
+    composes exactly that from the measured pieces. The per-entity cost is
+    extrapolated linearly from ``sklearn_entities_sampled`` entities — the
+    one estimated step, and the reason the headline ratio is labeled an
+    estimate. Single-class entities (sklearn refuses them) count at the
+    sampled mean.
+    """
+    try:
+        from sklearn.linear_model import LogisticRegression
+    except Exception:  # pragma: no cover
+        return {"sklearn_skipped": "scikit-learn not available"}
+
+    x, xu, xm, users, movies, y = _synth_arrays("logistic")
+    t0 = time.perf_counter()
+    LogisticRegression(C=1.0, solver="lbfgs", max_iter=100).fit(x, y)
+    fe_seconds = time.perf_counter() - t0
+
+    rng = np.random.default_rng(0)
+    sample = 400
+
+    def per_entity_seconds(codes, feats, n_groups):
+        order = np.argsort(codes, kind="stable")
+        sorted_codes = codes[order]
+        starts = np.searchsorted(sorted_codes, np.arange(n_groups))
+        ends = np.append(starts[1:], codes.shape[0])
+        picks = rng.choice(n_groups, size=min(sample, n_groups),
+                           replace=False)
+        t0 = time.perf_counter()
+        fitted = 0
+        for e in picks:
+            rows = order[starts[e]:ends[e]]
+            if rows.size == 0:
+                continue
+            ye = y[rows]
+            if ye.min() == ye.max():
+                continue  # single-class: counted at the sampled mean
+            LogisticRegression(C=1.0, solver="lbfgs", max_iter=100).fit(
+                feats[rows], ye)
+            fitted += 1
+        dt = time.perf_counter() - t0
+        return dt / max(fitted, 1)
+
+    user_s = per_entity_seconds(users, xu, N_USERS)
+    movie_s = per_entity_seconds(movies, xm, N_MOVIES)
+    sweep = fe_seconds + user_s * N_USERS + movie_s * N_MOVIES
+    total = sweep * CD_ITERATIONS
+    return {
+        "sklearn_fe_fit_seconds": round(fe_seconds, 3),
+        "sklearn_re_seconds_per_user": round(user_s, 6),
+        "sklearn_re_seconds_per_movie": round(movie_s, 6),
+        "sklearn_entities_sampled": 2 * sample,
+        "sklearn_glmix_fit_seconds_est": round(total, 1),
+        # measured-sklearn wall / our measured steady-state fit wall.
+        "vs_measured_sklearn": round(total / our_per_fit_seconds, 1),
+    }
 
 
 def build_estimator(task_name="linear"):
@@ -276,29 +372,61 @@ def estimate_hbm_bytes(result, datasets, task_name) -> float:
     return bytes_
 
 
+def _fit_blocking(est, data):
+    """One full fit, completion forced via on-device checksums.
+
+    Training dispatch is asynchronous and jax.block_until_ready returns at
+    ENQUEUE on the tunneled TPU backend, so completion is forced by
+    pulling a scalar checksum derived (on device) from every trained
+    coefficient table. The tables stay on device — the state production
+    scoring consumes; a full host pull would add ~0.9s/fit of pure tunnel
+    transfer. (Round-3's 8ms "train_seconds" was an enqueue time; this is
+    the fix.)
+    """
+    import jax.numpy as jnp
+
+    r = est.fit(data)[0]
+    for m in r.model.models.values():
+        c = (m.coefficients if hasattr(m, "coefficients")
+             else m.model.coefficients.means)
+        float(np.asarray(jnp.sum(c)))
+    return r
+
+
+def _flush_device_queue(data):
+    """Force completion of the dataset's raw-shard transfers.
+
+    make_game_dataset's device pushes are asynchronous; without this, the
+    NEXT phase's timer absorbs the transfer backlog of the synthetic-data
+    build (measured: the second variant's ingest read 26s of which ~24
+    was the first variant's leftover queue). block_until_ready returns at
+    enqueue on the tunneled backend, so completion is forced by pulling a
+    scalar reduction per shard.
+    """
+    import gc
+
+    import jax.numpy as jnp
+
+    gc.collect()  # drop the previous variant's device arrays first
+    for feats in data.feature_shards.values():
+        x = getattr(feats, "x", None)
+        if x is None:
+            x = feats.values
+        float(np.asarray(jnp.sum(x[:1])))
+    float(np.asarray(jnp.sum(data.labels)))
+
+
 def run_variant(task_name):
     data = build_data(task_name)
     est = build_estimator(task_name)
+    _flush_device_queue(data)
 
     t0 = time.perf_counter()
     datasets, _ = est.prepare(data)
     ingest_seconds = time.perf_counter() - t0
 
-    def fit_blocking():
-        # Training dispatch is asynchronous. NOTE: jax.block_until_ready
-        # returns at ENQUEUE on the tunneled TPU backend, so completion is
-        # forced the only reliable way — pulling the trained coefficients
-        # to the host. (Round-3's 8ms "train_seconds" was an enqueue time;
-        # this is the fix.)
-        r = est.fit(data)[0]
-        for m in r.model.models.values():
-            c = (m.coefficients if hasattr(m, "coefficients")
-                 else m.model.coefficients.means)
-            float(np.asarray(c).sum())
-        return r
-
     t0 = time.perf_counter()
-    fit_blocking()
+    _fit_blocking(est, data)
     compile_seconds = time.perf_counter() - t0
 
     # Steady state: aggregate whole fits until the measurement window is
@@ -307,12 +435,25 @@ def run_variant(task_name):
     result = None
     t0 = time.perf_counter()
     while True:
-        result = fit_blocking()
+        result = _fit_blocking(est, data)
         fits += 1
         train_seconds_total = time.perf_counter() - t0
         if train_seconds_total >= MIN_MEASURE_SECONDS and fits >= 3:
             break
     per_fit = train_seconds_total / fits
+
+    # Warm-cache e2e: a COMPLETE second cycle — fresh data objects, fresh
+    # estimator, prepare + first fit — in the same process, where the jit
+    # and transfer-shape caches are warm. This is the daily-cadence rerun
+    # cost the persistent compile cache is for.
+    data2 = build_data(task_name)
+    est2 = build_estimator(task_name)
+    _flush_device_queue(data2)
+    t0 = time.perf_counter()
+    est2.prepare(data2)
+    _fit_blocking(est2, data2)
+    warm_e2e = time.perf_counter() - t0
+    del data2, est2
 
     flops = estimate_model_flops(result, datasets, task_name)
     hbm = estimate_hbm_bytes(result, datasets, task_name)
@@ -326,18 +467,23 @@ def run_variant(task_name):
         model_flops_per_sec=flops / per_fit,
         hbm_bytes_per_sec=hbm / per_fit,
         e2e_seconds=ingest_seconds + compile_seconds,
+        warm_cache_e2e_seconds=warm_e2e,
     )
 
 
 def run_yahoo_music():
-    """Real-data timed run on the reference's Yahoo! Music fixture.
+    """SCHEMA-PARITY SMOKE TEST on the reference's Yahoo! Music fixture.
 
-    3-coordinate GLMix (global + per-user + per-song) through the product
-    estimator; RMSE evaluated on the training rows against the frozen
-    GameTrainingDriverIntegTest threshold.
+    The fixture (GameIntegTest/input/duplicateFeatures) is a 6-record
+    schema-edge-case file; training it as a 3-coordinate GLMix (global +
+    per-user + per-song) through the product estimator proves the
+    reference's Avro layout ingests and trains end-to-end. The RMSE
+    threshold (GameTrainingDriverIntegTest.scala:78-79) is kept as the
+    smoke gate, but 6 rows validate FORMATS, not model quality — the
+    real-data quality anchor is the a9a block.
     """
     if not os.path.exists(YAHOO_TRAIN):
-        return {"yahoo_music_skipped": "fixture not mounted"}
+        return {"yahoo_fixture_skipped": "fixture not mounted"}
     import jax.numpy as jnp
 
     from photon_tpu import optim
@@ -410,11 +556,12 @@ def run_yahoo_music():
     seconds = time.perf_counter() - t0
     rmse = float(result.evaluation.primary_evaluation)
     return {
-        "yahoo_music_rows": len(recs),
-        "yahoo_music_seconds": round(seconds, 3),
-        "yahoo_music_rmse": round(rmse, 4),
-        # GameTrainingDriverIntegTest.scala:78-79 frozen threshold.
-        "yahoo_music_rmse_ok": bool(rmse < 1.697),
+        "yahoo_fixture_rows": len(recs),
+        "yahoo_fixture_seconds": round(seconds, 3),
+        "yahoo_fixture_rmse": round(rmse, 4),
+        # GameTrainingDriverIntegTest.scala:78-79 threshold as a SMOKE
+        # gate on the 6-row fixture (schema parity, not model quality).
+        "yahoo_fixture_schema_smoke_ok": bool(rmse < 1.697),
     }
 
 
@@ -468,6 +615,102 @@ def run_a1a_logistic():
     }
 
 
+def run_wide_d():
+    """Huge-d sparse fixed effect on the real chip, through `photon train`.
+
+    The reference's headline capability claim is coefficient-vector scale
+    ("hundreds of billions of coefficients" across a cluster,
+    /root/reference/README.md:56); its single-chip unit of proof here is a
+    d = 10^7 sparse logistic fixed effect — power-law feature draws (the
+    long-tail shape hashed vocabularies exist for), ELL layout, L-BFGS —
+    driven end-to-end by the CLI training driver. Reported: d, nnz,
+    wall-clock, held-in AUC, and the resident coefficient + data bytes.
+    """
+    import json as json_mod
+    import tempfile
+
+    d = 10_000_000
+    rows = 100_000
+    k = 20
+    rng = np.random.default_rng(7)
+    # Power-law ids: density ~ 1/sqrt(u) concentrates mass on low ids.
+    idx = np.minimum(
+        (d * rng.uniform(size=(rows, k)) ** 2.2).astype(np.int64), d - 1
+    )
+    val = rng.normal(size=(rows, k)).astype(np.float32)
+    w_true = np.zeros(100_000, np.float32)
+    w_true[:] = rng.normal(size=100_000) * 0.5
+    planted = np.where(idx < 100_000, w_true[np.minimum(idx, 99_999)], 0.0)
+    z = (val * planted).sum(axis=1)
+    y = (rng.uniform(size=rows) < 1.0 / (1.0 + np.exp(-z))).astype(np.int8)
+
+    tmp = tempfile.mkdtemp(prefix="photon_wide_d")
+    train_path = os.path.join(tmp, "wide.libsvm")
+    t0 = time.perf_counter()
+    with open(train_path, "w") as f:
+        for i in range(rows):
+            order = np.argsort(idx[i])
+            feats = " ".join(
+                f"{int(idx[i][j]) + 1}:{val[i][j]:.5f}" for j in order
+            )
+            f.write(f"{int(y[i])} {feats}\n")
+    write_seconds = time.perf_counter() - t0
+    cfg = {
+        "task": "logistic_regression",
+        "output_dir": os.path.join(tmp, "out"),
+        "input": {
+            "format": "libsvm",
+            "train_path": train_path,
+            # Held-IN evaluation (same file): the block proves scale, and
+            # the AUC is a sanity signal that the d=1e7 solve actually
+            # learned the planted signal — not a generalization claim.
+            "validation_path": train_path,
+        },
+        "coordinates": {
+            "global": {
+                "type": "fixed",
+                "feature_shard": "features",
+                "regularization": {"type": "L2", "weight": 1.0},
+            }
+        },
+        "evaluators": ["AUC"],
+        "mesh": "off",
+    }
+    cfg_path = os.path.join(tmp, "cfg.json")
+    with open(cfg_path, "w") as f:
+        json_mod.dump(cfg, f)
+    from photon_tpu.cli.train import main as train_main
+
+    t0 = time.perf_counter()
+    rc = train_main(["--config", cfg_path])
+    seconds = time.perf_counter() - t0
+    summary = {}
+    spath = os.path.join(tmp, "out", "training-summary.json")
+    if os.path.exists(spath):
+        with open(spath) as f:
+            summary = json_mod.load(f)
+    auc = None
+    configs = summary.get("configurations") or []
+    if configs:
+        ev = configs[summary.get("best_configuration_index", 0)].get(
+            "evaluation") or {}
+        auc = ev.get("AUC")
+    return {
+        "wide_d_features": d,
+        "wide_d_rows": rows,
+        "wide_d_nnz": rows * k,
+        "wide_d_write_seconds": round(write_seconds, 2),
+        "wide_d_train_seconds": round(seconds, 2),
+        "wide_d_rc": rc,
+        "wide_d_heldin_auc": (
+            None if auc is None else round(float(auc), 4)),
+        # Device-resident footprint of the solve: ELL data + indices +
+        # the [d] coefficient/gradient vectors (f32).
+        "wide_d_resident_mb": round(
+            (rows * k * 8 + 2 * d * 4) / 1e6, 1),
+    }
+
+
 def main():
     from photon_tpu.utils import enable_compilation_cache
 
@@ -477,8 +720,10 @@ def main():
 
     logi = run_variant("logistic")
     lin = run_variant("linear")
+    sklearn_anchor = run_sklearn_baseline(logi["train_seconds"])
     yahoo = run_yahoo_music()
     a9a = run_a1a_logistic()
+    wide = run_wide_d()
 
     regressions = []
     if logi["rows_per_sec"] < FLOORS["logistic_rows_per_sec"]:
@@ -520,6 +765,8 @@ def main():
                 N_ROWS / v["ingest_seconds"], 1),
             f"{name}_compile_seconds": round(v["compile_seconds"], 3),
             f"{name}_e2e_seconds": round(v["e2e_seconds"], 3),
+            f"{name}_warm_cache_e2e_seconds": round(
+                v["warm_cache_e2e_seconds"], 3),
             f"{name}_model_flops_per_sec": round(
                 v["model_flops_per_sec"], 1),
             f"{name}_fraction_of_bf16_peak": round(
@@ -528,8 +775,10 @@ def main():
             f"{name}_fraction_of_hbm_peak": round(
                 v["hbm_bytes_per_sec"] / PEAK_HBM_BYTES, 6),
         })
+    out.update(sklearn_anchor)
     out.update(yahoo)
     out.update(a9a)
+    out.update(wide)
     print(json.dumps(out))
 
 

@@ -241,9 +241,23 @@ class FusedFit:
                     real = codes[codes < ds.num_entities]
                     keep[real] = True
                 _, passive = ds.covered_row_partition()
+                # Packed-plan layout: (element offset, shape) per plan
+                # array inside the ingest's single packed device buffer,
+                # so the materialization program can slice them IN-TRACE
+                # (no split program, no per-shape transfers). The layout
+                # contract is the view's static_slices() — None for the
+                # non-packed fallback.
+                pv = ds.packed_view
+                slices = buf = None
+                if pv is not None:
+                    slices = pv.static_slices()
+                    buf = pv.buffer if slices is not None else None
                 self._re_meta[cid] = {
                     "keep": keep,
                     "passive": passive if passive.size else None,
+                    "slices": slices,
+                    "buf": buf,
+                    "n_blocks": len(ds.blocks),
                 }
         # FE normalization contexts ride as trace-time constants: the
         # factor/shift arrays are tiny [d] vectors fixed per estimator
@@ -258,17 +272,15 @@ class FusedFit:
             )
         self._jit = jax.jit(self._fit_fn, static_argnames=("statics",))
         # Slab materialization runs ONCE per dataset generation as its own
-        # single program (every bucket of every RE coordinate together);
-        # its outputs feed the fit program as plain operands. Folding it
-        # into the fit would re-gather ~0.4s of slabs on every repeated
-        # fit; leaving it per-bucket (the unfused device_blocks() path)
-        # costs one compile round trip per bucket on a remote backend.
-        self._mat_jit = jax.jit(
-            lambda plans: tuple(
-                tuple(p.materialize(None) for p in pl) for pl in plans
-            )
-        )
-        self._mat_cache: tuple | None = None
+        # single program (every bucket of every RE coordinate together,
+        # including the in-trace unpacking of the ingest's packed plan
+        # buffer); its outputs feed the fit program as plain operands.
+        # Folding it into the fit would re-gather ~0.4s of slabs on every
+        # repeated fit; leaving it per-bucket (the unfused device_blocks()
+        # path) costs one compile round trip per bucket on a remote
+        # backend.
+        self._mat_jit = jax.jit(self._mat_fn)
+        self._mat_cache: dict | None = None
         # Zero warm-start tables, created once per generation: an eager
         # jnp.zeros([100k, S]) costs a ~250ms device round trip on the
         # tunneled backend, which would otherwise recur on every fit.
@@ -278,6 +290,55 @@ class FusedFit:
     # ------------------------------------------------------------------
     # operand assembly (per run; cheap)
     # ------------------------------------------------------------------
+
+    def _mat_fn(self, mat_ops: dict):
+        """Unpack plan arrays + materialize every bucket slab, traced.
+
+        Per RE coordinate: slice the packed ingest buffer into the plan
+        arrays (static offsets — free in-trace), rebuild the BlockPlans,
+        gather the [B, R, S] slabs, and emit (EntityBlocks, scoring plan
+        arrays, projector table) — everything later fits consume."""
+        from photon_tpu.data.random_effect import BlockPlan
+
+        out = {}
+        for cid, op in mat_ops.items():
+            meta = self._re_meta[cid]
+            if "buf" in op:
+                arrays = []
+                for off, shape in meta["slices"]:
+                    n = int(np.prod(shape)) if shape else 1
+                    arrays.append(
+                        jax.lax.slice_in_dim(
+                            op["buf"], off, off + n).reshape(shape)
+                    )
+                plans = [
+                    BlockPlan(
+                        entity_codes=arrays[5 * i],
+                        row_ids=arrays[5 * i + 1],
+                        row_counts=arrays[5 * i + 2],
+                        proj=arrays[5 * i + 3],
+                        intercept_slots=arrays[5 * i + 4],
+                        raw=op["raw"],
+                        raw_labels=op["labels"],
+                        raw_offsets=op["offsets"],
+                        raw_weights=op["weights"],
+                    )
+                    for i in range(meta["n_blocks"])
+                ]
+                proj_dev = arrays[-1]
+            else:
+                plans = list(op["plans"])
+                proj_dev = op["proj_dev"]
+            ebs = tuple(p.materialize(None) for p in plans)
+            out[cid] = {
+                "ebs": ebs,
+                "score_plans": tuple(
+                    (p.row_ids, p.row_counts, p.entity_codes)
+                    for p in plans
+                ),
+                "proj_dev": proj_dev,
+            }
+        return out
 
     def _zeros(self, shape, dtype) -> Array:
         key = (shape, jnp.dtype(dtype).name)
@@ -350,7 +411,6 @@ class FusedFit:
                              inner.prior.variances)
                 meta = self._re_meta[cid]
                 ops.append({
-                    "blocks": tuple(ds.blocks),
                     "w0": (w0 if w0 is not None else self._zeros(
                         (ds.num_entities, ds.max_sub_dim), dtype)),
                     "l1": np.asarray(cfg.l1_weight, dtype=dtype),
@@ -361,11 +421,34 @@ class FusedFit:
                     "shifts": inner.normalization.shifts,
                     "score_codes": ds.score_codes,
                     "raw": ds.raw,
-                    "proj_dev": ds.proj_dev,
                     "passive": (None if meta["passive"] is None
                                 else jnp.asarray(meta["passive"])),
                 })
         return tuple(ops)
+
+    def _mat_operands(self, coords) -> dict:
+        mat_ops = {}
+        for cid in self.seq:
+            if self.kinds[cid] != "random":
+                continue
+            inner = getattr(coords[cid], "inner", coords[cid])
+            ds = inner.dataset
+            meta = self._re_meta[cid]
+            if meta["slices"] is not None and ds.blocks:
+                b0 = ds.blocks[0]
+                mat_ops[cid] = {
+                    "buf": meta["buf"],
+                    "raw": ds.raw,
+                    "labels": b0.raw_labels,
+                    "offsets": b0.raw_offsets,
+                    "weights": b0.raw_weights,
+                }
+            else:
+                mat_ops[cid] = {
+                    "plans": ds.device_plans(),
+                    "proj_dev": ds.proj_device(),
+                }
+        return mat_ops
 
     def _statics(self, coords, initial_models) -> tuple:
         st = []
@@ -399,33 +482,34 @@ class FusedFit:
     # the traced program
     # ------------------------------------------------------------------
 
-    def _re_score(self, w, op, ebs):
+    def _re_score(self, w, op, mat):
         """Model contribution per canonical row (active+passive), traced.
 
         Mirrors models/game.py _score_via_buckets with operand arrays."""
         from photon_tpu.data.dataset import DenseFeatures
 
         n = op["score_codes"].shape[0]
-        if any(eb.x_indices is not None for eb in ebs):
+        proj_dev = mat["proj_dev"]
+        if any(eb.x_indices is not None for eb in mat["ebs"]):
             # ELL fallback bucket present: score straight off the raw shard.
             return score_raw_features(
-                w, op["score_codes"], op["raw"], op["proj_dev"])
+                w, op["score_codes"], op["raw"], proj_dev)
         z = jnp.zeros(n, dtype=w.dtype)
-        for plan, eb in zip(op["blocks"], ebs):
+        for (row_ids, row_counts, codes), eb in zip(
+            mat["score_plans"], mat["ebs"]
+        ):
             z = _bucket_score_add(
-                z, eb.x_values, plan.row_ids, plan.row_counts,
-                plan.entity_codes, w,
+                z, eb.x_values, row_ids, row_counts, codes, w,
             )
         if op["passive"] is not None:
             pr = op["passive"]
             if isinstance(op["raw"], DenseFeatures):
                 z = _passive_score_set_dense(
-                    z, pr, op["score_codes"], op["raw"].x, w,
-                    op["proj_dev"])
+                    z, pr, op["score_codes"], op["raw"].x, w, proj_dev)
             else:
                 z = _passive_score_set_sparse(
                     z, pr, op["score_codes"], op["raw"].indices,
-                    op["raw"].values, w, op["proj_dev"])
+                    op["raw"].values, w, proj_dev)
         return z
 
     def _fe_score(self, means, batch):
@@ -474,7 +558,8 @@ class FusedFit:
                 )
                 states.append((w_all, v_all))
                 scores.append(
-                    self._re_score(w_all, op, ebs_all[i]) if has_init
+                    self._re_score(w_all, op, ebs_all[self.seq[i]])
+                    if has_init
                     else jnp.zeros(
                         op["score_codes"].shape[0], w_all.dtype)
                 )
@@ -529,7 +614,10 @@ class FusedFit:
                     e = w_prev.shape[0]
                     its_e = jnp.zeros(e, jnp.int32)
                     rs_e = jnp.zeros(e, jnp.int32)
-                    for plan, eb in zip(op["blocks"], ebs_all[i]):
+                    mat = ebs_all[self.seq[i]]
+                    for (_, _, codes), eb in zip(
+                        mat["score_plans"], mat["ebs"]
+                    ):
                         w_all, v_all, its, rs = _solve_block(
                             eb,
                             residual,
@@ -547,10 +635,10 @@ class FusedFit:
                             direct=direct,
                             newton=newton,
                         )
-                        its_e = its_e.at[plan.entity_codes].set(its)
-                        rs_e = rs_e.at[plan.entity_codes].set(rs)
+                        its_e = its_e.at[codes].set(its)
+                        rs_e = rs_e.at[codes].set(rs)
                     states[i] = (w_all, v_all)
-                    z = self._re_score(w_all, op, ebs_all[i])
+                    z = self._re_score(w_all, op, mat)
                     it_arr, rs_arr = diags[i]
                     diags[i] = (
                         it_arr.at[it].set(its_e),
@@ -594,13 +682,10 @@ class FusedFit:
         ops = self._operands(coords, initial_models)
         statics = self._statics(coords, initial_models)
         # Slabs materialize once per dataset generation (separate cached
-        # program); every fit's program receives them as plain operands.
+        # program that also unpacks the ingest's packed plan buffer);
+        # every fit's program receives the results as plain operands.
         if self._mat_cache is None:
-            plans = tuple(
-                op["blocks"] if st[0] == "random" else ()
-                for op, st in zip(ops, statics)
-            )
-            self._mat_cache = self._mat_jit(plans)
+            self._mat_cache = self._mat_jit(self._mat_operands(coords))
         ebs_all = self._mat_cache
         states, scores, total, packed_flat = self._jit(
             ops, ebs_all, statics=statics)

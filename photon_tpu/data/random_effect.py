@@ -347,11 +347,79 @@ class RandomEffectDataset:
     # [n] bool host mask: rows kept into some training block (built from the
     # planner's rows_flat, so no device work is needed to derive it).
     covered_np: np.ndarray | None = None
+    # Lazy device placement: every plan array of the build rides ONE packed
+    # int32 device buffer (one transfer-shape setup for the whole ingest,
+    # ~65ms instead of ~30 x 65ms on remote links); the fused fit slices it
+    # IN-TRACE (zero extra programs), while eager consumers split it once
+    # through ``device_plans()``. ``blocks`` carries host-numpy plan leaves
+    # when this is set.
+    packed_view: object | None = None
 
     @property
     def num_rows(self) -> int:
         """Canonical row count of the table this dataset was built from."""
         return int(self.score_codes.shape[0])
+
+    def device_plans(self) -> tuple:
+        """``blocks`` with DEVICE plan arrays (cached).
+
+        Lazy-packed datasets split the packed buffer with one jitted
+        program on first need — only the unfused training/scoring paths
+        pay it; the fused fit slices the buffer inside its own programs.
+        """
+        cached = getattr(self, "_device_plans", None)
+        if cached is not None:
+            return cached
+        first = self.blocks[0] if self.blocks else None
+        if first is None or not isinstance(first, BlockPlan) or isinstance(
+            first.entity_codes, jax.Array
+        ):
+            out = self.blocks  # already device-resident (or materialized)
+        elif self.packed_view is not None:
+            devs = self.packed_view.device_arrays()
+            out = tuple(
+                dataclasses.replace(
+                    b,
+                    entity_codes=devs[5 * i],
+                    row_ids=devs[5 * i + 1],
+                    row_counts=devs[5 * i + 2],
+                    proj=devs[5 * i + 3],
+                    intercept_slots=devs[5 * i + 4],
+                )
+                for i, b in enumerate(self.blocks)
+            )
+        else:
+            leaves = jax.device_put([
+                arr for b in self.blocks
+                for arr in (b.entity_codes, b.row_ids, b.row_counts,
+                            b.proj, b.intercept_slots)
+            ])
+            out = tuple(
+                dataclasses.replace(
+                    b,
+                    entity_codes=leaves[5 * i],
+                    row_ids=leaves[5 * i + 1],
+                    row_counts=leaves[5 * i + 2],
+                    proj=leaves[5 * i + 3],
+                    intercept_slots=leaves[5 * i + 4],
+                )
+                for i, b in enumerate(self.blocks)
+            )
+        object.__setattr__(self, "_device_plans", out)
+        return out
+
+    def proj_device(self) -> Array:
+        """[E, max_sub_dim] int32 device projector table (cached)."""
+        if self.proj_dev is not None:
+            return self.proj_dev
+        cached = getattr(self, "_proj_dev_cache", None)
+        if cached is None:
+            if self.packed_view is not None:
+                cached = self.packed_view.device_arrays()[-1]
+            else:
+                cached = jnp.asarray(self.proj_all.astype(np.int32))
+            object.__setattr__(self, "_proj_dev_cache", cached)
+        return cached
 
     def device_blocks(self) -> tuple:
         """Training blocks with feature slabs materialized ON DEVICE (cached).
@@ -371,7 +439,7 @@ class RandomEffectDataset:
         out = []
         spent = 0  # the budget bounds the TOTAL cached bytes, not per block
         itemsize = np.dtype(self.dtype).itemsize
-        for b in self.blocks:
+        for b in self.device_plans():
             if isinstance(b, BlockPlan):
                 bb, r = b.row_ids.shape
                 s = b.proj.shape[-1]
@@ -677,15 +745,43 @@ def _plan_random_effect(
 
     # --- 1. deterministic reservoir cap: per entity keep the
     # active_data_upper_bound rows with smallest hash keys -----------------
-    seed = _stable_type_seed(config.random_effect_type)
-    order_keys = _byteswap64_mix(uids, seed)
-    perm = np.lexsort((order_keys, codes))
-    sorted_codes = codes[perm]
-    starts = np.searchsorted(sorted_codes, np.arange(num_entities))
     counts_full = np.bincount(codes, minlength=num_entities).astype(np.int64)
-
     upper = config.active_data_upper_bound
     lower = config.active_data_lower_bound
+    cap_binds = upper is not None and bool(
+        counts_full.max(initial=0) > upper
+    )
+    if cap_binds:
+        seed = _stable_type_seed(config.random_effect_type)
+        order_keys = _byteswap64_mix(uids, seed)
+        # Group-by-entity, ordered by hash within the group. A two-key
+        # lexsort costs two comparison sorts (~1.5s at 4M rows — the
+        # single hottest planning op); packing (code, high hash bits) into
+        # one int64 lets numpy's stable integer argsort run as an O(n)
+        # radix sort instead. Within-entity ties on the truncated hash
+        # fall back to stable row order — still a deterministic uniform
+        # reservoir (the hash bits kept exceed 2x log2(n) for any E below
+        # 2^20, so ties are vanishing).
+        code_bits = max(int(num_entities - 1).bit_length(), 1)
+        if code_bits <= 40:
+            hash_bits = 63 - code_bits
+            key = (codes << hash_bits) | (
+                order_keys.astype(np.uint64) >> np.uint64(64 - hash_bits)
+            ).astype(np.int64)
+            perm = np.argsort(key, kind="stable")
+        else:  # pathological entity counts: keep the exact two-key sort
+            perm = np.lexsort((order_keys, codes))
+    else:
+        # No entity exceeds the cap (or no cap): the reservoir keeps every
+        # row, so within-entity order is irrelevant — group by entity
+        # alone with a narrow radix sort and skip the hashing pass.
+        sort_codes = (
+            codes.astype(np.int32) if num_entities <= (1 << 31) - 1
+            else codes
+        )
+        perm = np.argsort(sort_codes, kind="stable")
+    sorted_codes = codes[perm]
+    starts = np.searchsorted(sorted_codes, np.arange(num_entities))
     counts = (
         counts_full if upper is None else np.minimum(counts_full, upper)
     )
@@ -721,21 +817,33 @@ def _plan_random_effect(
         # is the hot ingest path for dense GLMix shards (the reference
         # amortizes the equivalent union across the cluster's foldByKey,
         # RandomEffectDataset.scala:390-426).
-        present = ell_val[rows_p] != 0.0  # [m, d]; rows grouped by entity
-        m = rows_p.shape[0]
-        seg_starts = np.searchsorted(pair_codes, np.arange(num_entities))
-        seg_ends = np.append(seg_starts[1:], m)
-        nonempty = seg_starts < seg_ends
-        # reduceat over the NONEMPTY starts only: consecutive empty
-        # segments share their successor's start, so a naive clamp of
-        # trailing starts to m-1 would shave the last row off the
-        # preceding entity's union. Nonempty starts partition [0, m)
-        # exactly (each spans to the next nonempty start).
-        presence = np.zeros((num_entities, ell_val.shape[1]), dtype=bool)
-        if nonempty.any():
-            presence[nonempty] = np.logical_or.reduceat(
-                present, seg_starts[nonempty], axis=0
-            )
+        # Compare BEFORE the row gather: the bool matrix is 4x narrower
+        # than the float values, so the fancy-index moves 4x fewer bytes.
+        nz = ell_val != 0.0
+        if nz.all():
+            # Fully dense data (no exact zeros anywhere): every active
+            # entity's subspace is the whole feature set — skip the
+            # gather + segment-OR entirely.
+            presence = np.zeros((num_entities, ell_val.shape[1]), bool)
+            presence[np.unique(pair_codes)] = True
+        else:
+            present = nz[rows_p]  # [m, d]; grouped by entity
+            m = rows_p.shape[0]
+            seg_starts = np.searchsorted(
+                pair_codes, np.arange(num_entities))
+            seg_ends = np.append(seg_starts[1:], m)
+            nonempty = seg_starts < seg_ends
+            # reduceat over the NONEMPTY starts only: consecutive empty
+            # segments share their successor's start, so a naive clamp of
+            # trailing starts to m-1 would shave the last row off the
+            # preceding entity's union. Nonempty starts partition [0, m)
+            # exactly (each spans to the next nonempty start).
+            presence = np.zeros(
+                (num_entities, ell_val.shape[1]), dtype=bool)
+            if nonempty.any():
+                presence[nonempty] = np.logical_or.reduceat(
+                    present, seg_starts[nonempty], axis=0
+                )
         rows_e, cols_f = np.nonzero(presence)
         # Row-major nonzero order == ascending key order (stride >= d).
         uniq = rows_e.astype(np.int64) * np.int64(stride) + cols_f
@@ -868,12 +976,6 @@ def _plan_random_effect(
     )
 
 
-# Below this many total bytes the plain batched device_put wins (tiny test
-# datasets skip the splitter compile; its XLA program is trivial but still a
-# per-shape-set compile).
-_PACKED_TRANSFER_MIN_BYTES = 2 << 20
-
-
 def _split_packed_impl(buf, shapes):
     out = []
     o = 0
@@ -887,23 +989,110 @@ def _split_packed_impl(buf, shapes):
 _split_packed = jax.jit(_split_packed_impl, static_argnames=("shapes",))
 
 
-def _plan_arrays_to_device(arrays: list[np.ndarray]):
-    """Push host plan arrays to device, minimizing transfer-path setup.
+class PackedPlanArrays:
+    """Every plan array of a build in ONE granule-padded int32 device
+    buffer.
 
-    Some device links (the dev-tunnel TPU backend here) pay a per-shape
-    first-transfer setup cost (~65ms each); 15+ distinct plan-array shapes
-    made that the dominant ingest cost. Packing everything into ONE int32
-    buffer pays one transfer and one (persistently cached, trivial) split
-    program instead. The buffer length is padded to a 4 MiB granule so its
-    transfer shape recurs across similarly-sized datasets with bounded
-    (< 4 MiB) padding overhead — power-of-two padding could nearly double
-    host memory and transfer bytes at n = 2^k + 1.
+    Remote device links pay a per-transfer-shape setup cost (~65ms each on
+    the dev-tunnel TPU backend); ~30 distinct plan-array shapes made that
+    the dominant ingest cost (~2s). One packed buffer pays ONE setup, and
+    nothing else happens at ingest time:
+
+    - the fused fit slices the buffer INSIDE its own traced programs
+      (``slice_in_trace`` — zero additional XLA programs, zero transfers);
+    - eager consumers (the unfused loop, tests, mesh sharding) split it
+      once through ``device_arrays()``, paying the splitter program's
+      compile only when that fallback path actually runs.
     """
-    total = sum(a.nbytes for a in arrays)
-    if total < _PACKED_TRANSFER_MIN_BYTES or any(
-        a.dtype != np.int32 for a in arrays
-    ):
-        return jax.device_put(arrays)
+
+    def __init__(self, buf: Array, shapes: tuple):
+        self.buf = buf
+        self.shapes = tuple(tuple(s) for s in shapes)
+        sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        offs = np.cumsum([0] + sizes)
+        self.offsets = tuple(int(o) for o in offs[:-1])
+        self._split: tuple | None = None
+
+    def __len__(self) -> int:
+        return len(self.shapes)
+
+    def view(self, lo: int, hi: int) -> "_PackedPlanView":
+        return _PackedPlanView(self, lo, hi)
+
+    @property
+    def buffer(self) -> Array:
+        return self.buf
+
+    def static_slices(self) -> tuple:
+        """((element offset, shape), ...) — THE layout contract for
+        traced consumers: slice ``buffer`` at these static offsets inside
+        a jit (the fused fit's materialization program does)."""
+        return tuple(zip(self.offsets, self.shapes))
+
+    def device_arrays(self) -> tuple:
+        if self._split is None:
+            self._split = _split_packed(self.buf, shapes=self.shapes)
+        return self._split
+
+
+class _PackedPlanView:
+    """Subrange of a PackedPlanArrays (one dataset's arrays of a multi-
+    coordinate batch transfer)."""
+
+    def __init__(self, packed: PackedPlanArrays, lo: int, hi: int):
+        self.packed = packed
+        self.lo = lo
+        self.hi = hi
+
+    def __len__(self) -> int:
+        return self.hi - self.lo
+
+    @property
+    def buffer(self) -> Array:
+        return self.packed.buf
+
+    def static_slices(self) -> tuple:
+        return self.packed.static_slices()[self.lo:self.hi]
+
+    def device_arrays(self) -> tuple:
+        return self.packed.device_arrays()[self.lo:self.hi]
+
+
+class _ListPlanArrays:
+    """Plain per-array placement fallback for non-int32 plan arrays.
+
+    ``static_slices`` is None: traced consumers fall back to taking the
+    per-array device handles as operands."""
+
+    static_slices = staticmethod(lambda: None)
+
+    def __init__(self, arrays):
+        self._arrays = None
+        self._host = list(arrays)
+
+    def __len__(self) -> int:
+        return len(self._host)
+
+    def view(self, lo: int, hi: int):
+        out = _ListPlanArrays(self._host[lo:hi])
+        return out
+
+    def device_arrays(self) -> tuple:
+        if self._arrays is None:
+            self._arrays = tuple(jax.device_put(self._host))
+        return self._arrays
+
+
+def _plan_arrays_to_device(arrays: list[np.ndarray]):
+    """Stage host plan arrays for device use: ONE packed transfer.
+
+    Returns a PackedPlanArrays (or a _ListPlanArrays fallback when dtypes
+    are mixed). Device placement of the packed buffer happens here — a
+    single granule-padded shape whose transfer path recurs across
+    similarly-sized datasets; per-array splits are deferred to consumers.
+    """
+    if any(a.dtype != np.int32 for a in arrays):
+        return _ListPlanArrays(arrays)
     shapes = tuple(a.shape for a in arrays)
     n = sum(a.size for a in arrays)
     granule = (4 << 20) // 4  # 4 MiB of int32 elements
@@ -914,7 +1103,7 @@ def _plan_arrays_to_device(arrays: list[np.ndarray]):
         flat[o:o + a.size] = a.ravel()
         o += a.size
     flat[o:] = 0
-    return list(_split_packed(jax.device_put(flat), shapes=shapes))
+    return PackedPlanArrays(jax.device_put(flat), shapes)
 
 
 def _bucket_rows(plan: _Plan, members: np.ndarray, cap: int):
@@ -1319,16 +1508,20 @@ def _finalize_lazy(
     devs, bucket_host, feats, game_data, config, num_entities, tag, plan,
     dtype, covered_np=None,
 ):
-    """Assemble the lazy RandomEffectDataset from placed plan arrays."""
+    """Assemble the lazy RandomEffectDataset around the packed plan view.
+
+    ``devs`` is a PackedPlanArrays/_PackedPlanView: the plan arrays stay
+    HOST numpy on the BlockPlan leaves (free), and device placement
+    resolves lazily — in-trace slices for the fused fit, one split
+    program via ``device_plans()`` for eager consumers."""
     blocks = []
-    for i, bh in enumerate(bucket_host):
-        m, brow, cnt, proj, ints = devs[5 * i:5 * i + 5]
+    for bh in bucket_host:
         blocks.append(BlockPlan(
-            entity_codes=m,
-            row_ids=brow,
-            row_counts=cnt,
-            proj=proj,
-            intercept_slots=ints,
+            entity_codes=bh["members"],
+            row_ids=bh["brow"],
+            row_counts=bh["counts"],
+            proj=bh["proj"],
+            intercept_slots=bh["intercepts"],
             raw=feats,
             raw_labels=game_data.labels,
             raw_offsets=game_data.offsets,
@@ -1346,10 +1539,11 @@ def _finalize_lazy(
         dtype=dtype,
         score_codes=tag.codes,
         raw=feats,
-        proj_dev=devs[-1],
+        proj_dev=None,
         block_codes_np=tuple(bh["members"] for bh in bucket_host),
         block_intercepts_np=tuple(
             bh["intercepts"] for bh in bucket_host
         ),
         covered_np=covered_np,
+        packed_view=devs,
     )

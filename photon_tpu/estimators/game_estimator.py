@@ -333,7 +333,7 @@ class GameEstimator:
         devs = _plan_arrays_to_device(all_flat)
         for cid, p in pending.items():
             lo, hi = spans[cid]
-            ds = p.finalize(devs[lo:hi])
+            ds = p.finalize(devs.view(lo, hi))
             if mesh is not None:
                 ds = shard_random_effect_dataset(ds, mesh)
             out[cid] = ds

@@ -88,7 +88,7 @@ class RandomEffectModel:
                 self.coefficients,
                 dataset.score_codes,
                 dataset.raw,
-                dataset.proj_dev,
+                dataset.proj_device(),
             )
         tail = None
         if dataset.score_tail_rows is not None:
@@ -137,12 +137,13 @@ def _score_via_buckets(w: Array, ds: RandomEffectDataset) -> Array | None:
     """
     from photon_tpu.data.dataset import DenseFeatures, SparseFeatures
 
+    plans = ds.device_plans()
     blocks = ds.device_blocks()
-    for plan, eb in zip(ds.blocks, blocks):
+    for plan, eb in zip(plans, blocks):
         if eb is plan or getattr(eb, "x_indices", True) is not None:
             return None
     z = jnp.zeros(ds.num_rows, dtype=w.dtype)
-    for plan, eb in zip(ds.blocks, blocks):
+    for plan, eb in zip(plans, blocks):
         z = _bucket_score_add(
             z, eb.x_values, plan.row_ids, plan.row_counts,
             plan.entity_codes, w,
@@ -153,12 +154,12 @@ def _score_via_buckets(w: Array, ds: RandomEffectDataset) -> Array | None:
         feats = ds.raw
         if isinstance(feats, DenseFeatures):
             z = _passive_score_set_dense(
-                z, pr, ds.score_codes, feats.x, w, ds.proj_dev
+                z, pr, ds.score_codes, feats.x, w, ds.proj_device()
             )
         else:
             z = _passive_score_set_sparse(
                 z, pr, ds.score_codes, feats.indices, feats.values,
-                w, ds.proj_dev,
+                w, ds.proj_device(),
             )
     return z
 

@@ -125,11 +125,16 @@ def shard_random_effect_dataset(
             })
         return jax.tree.map(place, b)
 
-    blocks = tuple(pad_block(i, b) for i, b in enumerate(ds.blocks))
+    blocks = tuple(
+        pad_block(i, b) for i, b in enumerate(ds.device_plans())
+    )
     rep = {
         "blocks": blocks,
         "block_codes_np": tuple(codes_np),
         "block_intercepts_np": tuple(ints_np),
+        # The sharded dataset's plan arrays are mesh-placed above; the
+        # single-device packed buffer must not shadow them.
+        "packed_view": None,
     }
     if ds.is_lazy:
         # Raw leaves must be replicated (BlockPlans gather arbitrary rows),
@@ -144,7 +149,7 @@ def shard_random_effect_dataset(
         rep.update(
             raw=replicate_cached(ds.raw),
             score_codes=codes,
-            proj_dev=replicate_cached(ds.proj_dev),
+            proj_dev=replicate_cached(ds.proj_device()),
         )
     elif ds.score_codes.shape[0] % n_dev == 0:
         rep.update(
