@@ -1,0 +1,237 @@
+"""Probe: ONE fused Newton step (H/g build -> CG -> line search -> new
+objective) as a Pallas kernel, H never leaving VMEM, vs the XLA batched
+step. Logistic loss, bench-user shapes.
+
+Round-4 findings honored: no batched dots (unroll BT entities as 2D
+dot_generals), operands kept 2D, 3D BlockSpecs, no reshapes across
+tilings.
+"""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+B, R, S = 99_976, 64, 17
+BT = 8
+T = 16  # line-search trials
+TS = (0.5 ** np.arange(T)).astype(np.float32)
+
+
+def _sigmoid(z):
+    return 1.0 / (1.0 + jnp.exp(-z))
+
+
+def kernel(x_ref, w_ref, y_ref, wt_ref, off_ref, l2_ref, mt_ref, vm_ref,
+           f_ref, w_out, f_out, g_out, imp_out):
+    # STRICT 2-D CONVENTION (Mosaic rejects 1-D length-S reductions with
+    # "Offset change"): per-entity S-vectors are [S, 1] columns, the
+    # line-search trial axis is a [1, T] row; every reduction is a full
+    # or single-axis reduce of a 2-D operand.
+    ts_row = jnp.exp2(-jax.lax.broadcasted_iota(
+        jnp.int32, (1, T), 1).astype(jnp.float32))  # [1, T]
+    eye = (
+        jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        == jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    ).astype(jnp.float32)
+    for j in range(BT):
+        xj = x_ref[j]                     # [R, S]
+        wj = w_ref[j][:, None]            # [S, 1]
+        l2 = l2_ref[j][:, None]
+        mt = mt_ref[j][:, None]
+        vm = vm_ref[j][:, None]
+        yj = y_ref[j][:, None]            # [R, 1]
+        wtj = wt_ref[j][:, None]
+        offj = off_ref[j][:, None]
+        z = xj @ wj + offj                # [R, 1]
+        p = _sigmoid(z)
+        c = wtj * p * (1 - p)
+        d1 = wtj * (p - yj)
+        h = xj.T @ (c * xj) + (l2 + (1.0 - vm)) * eye
+        g = (xj.T @ d1 + l2 * (wj - mt)) * vm  # [S, 1]
+
+        b0 = -g
+
+        def cg_step(_, st):
+            xx, rr, pp, rs = st
+            hp = h @ pp
+            alpha = rs / jnp.maximum(jnp.sum(pp * hp), 1e-30)
+            xx = xx + alpha * pp
+            rr = rr - alpha * hp
+            rs2 = jnp.sum(rr * rr)
+            pp = rr + (rs2 / jnp.maximum(rs, 1e-30)) * pp
+            return xx, rr, pp, rs2
+
+        d, _, _, _ = lax.fori_loop(
+            0, S, cg_step, (jnp.zeros_like(b0), b0, b0, jnp.sum(b0 * b0))
+        )
+        d = d * vm
+        gd = jnp.sum(g * d)
+        bad = gd >= 0.0
+        d = jnp.where(bad, -g, d)
+        gd = jnp.where(bad, -jnp.sum(g * g), gd)
+
+        zd = xj @ d                        # [R, 1]
+        f_prev = f_ref[j, 0]
+        z_t = z + zd * ts_row              # [R, T]
+        loss_t = jnp.log1p(jnp.exp(-jnp.abs(z_t))) + jnp.maximum(z_t, 0.0) \
+            - z_t * yj
+        data_t = jnp.sum(wtj * loss_t, axis=0, keepdims=True)  # [1, T]
+        w_t = wj + d * ts_row              # [S, T]
+        reg_t = 0.5 * jnp.sum(
+            l2 * (w_t - mt) ** 2, axis=0, keepdims=True)
+        f_t = data_t + reg_t               # [1, T]
+        armijo = f_t <= f_prev + 1e-4 * ts_row * gd
+        # First (largest) passing t == max over passing trials: ts is
+        # strictly decreasing (argmax on bools doesn't lower).
+        t_sel = jnp.max(jnp.where(armijo, ts_row, 0.0))
+        any_ok = t_sel > 0.0
+        f_sel = jnp.sum(jnp.where(ts_row == t_sel, f_t, 0.0))
+        improved = jnp.logical_and(any_ok, f_sel < f_prev)
+        w_new = jnp.where(improved, wj + t_sel * d, wj)  # [S, 1]
+
+        # Fresh objective + gradient at w_new (slab still in VMEM).
+        z2 = xj @ w_new + offj
+        loss2 = jnp.log1p(jnp.exp(-jnp.abs(z2))) + jnp.maximum(z2, 0.0) \
+            - z2 * yj
+        f_new = jnp.sum(wtj * loss2) + 0.5 * jnp.sum(
+            l2 * (w_new - mt) ** 2)
+        p2 = _sigmoid(z2)
+        g_new = (xj.T @ (wtj * (p2 - yj)) + l2 * (w_new - mt)) * vm
+
+        w_out[j] = w_new[:, 0]
+        f_out[j, :] = f_new[None]
+        g_out[j] = g_new[:, 0]
+        imp_out[j, :] = improved.astype(jnp.float32)[None]
+
+
+@jax.jit
+def pallas_step(x, w, y, wt, off, l2, mt, vm, f):
+    nb = x.shape[0] // BT
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((BT, R, S), lambda i: (i, 0, 0)),
+            pl.BlockSpec((BT, S), lambda i: (i, 0)),
+            pl.BlockSpec((BT, R), lambda i: (i, 0)),
+            pl.BlockSpec((BT, R), lambda i: (i, 0)),
+            pl.BlockSpec((BT, R), lambda i: (i, 0)),
+            pl.BlockSpec((BT, S), lambda i: (i, 0)),
+            pl.BlockSpec((BT, S), lambda i: (i, 0)),
+            pl.BlockSpec((BT, S), lambda i: (i, 0)),
+            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((BT, S), lambda i: (i, 0)),
+            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
+            pl.BlockSpec((BT, S), lambda i: (i, 0)),
+            pl.BlockSpec((BT, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((x.shape[0], S), jnp.float32),
+            jax.ShapeDtypeStruct((x.shape[0], 1), jnp.float32),
+            jax.ShapeDtypeStruct((x.shape[0], S), jnp.float32),
+            jax.ShapeDtypeStruct((x.shape[0], 1), jnp.float32),
+        ],
+    )(x, w, y, wt, off, l2, mt, vm, f)
+
+
+@jax.jit
+def xla_step(x, w, y, wt, off, l2, mt, vm, f):
+    """The batch-minor XLA step (mirrors _solve_newton_batched's body)."""
+    f = f[:, 0]
+    z = jnp.einsum("brs,bs->br", x, w) + off
+    p = jax.nn.sigmoid(z)
+    c = wt * p * (1 - p)
+    h = jnp.einsum("brs,brt->bst", x * c[:, :, None], x)
+    h = h + (l2 + (1.0 - vm))[:, :, None] * jnp.eye(S)[None]
+    g = (jnp.einsum("brs,br->bs", x, wt * (p - y)) + l2 * (w - mt)) * vm
+    h_sb = jnp.transpose(h, (1, 2, 0))
+
+    def cg_step(_, st):
+        xx, rr, pp, rs = st
+        hp = jnp.sum(h_sb * pp[None, :, :], axis=1)
+        denom = jnp.sum(pp * hp, axis=0)
+        alpha = rs / jnp.maximum(denom, 1e-30)
+        xx = xx + alpha[None] * pp
+        rr = rr - alpha[None] * hp
+        rs2 = jnp.sum(rr * rr, axis=0)
+        pp = rr + (rs2 / jnp.maximum(rs, 1e-30))[None] * pp
+        return xx, rr, pp, rs2
+
+    b0 = -jnp.transpose(g)
+    d0, _, _, _ = lax.fori_loop(
+        0, S, cg_step,
+        (jnp.zeros_like(b0), b0, b0, jnp.sum(b0 * b0, axis=0)))
+    d = jnp.transpose(d0) * vm
+    gd = jnp.sum(g * d, axis=-1)
+    bad = gd >= 0.0
+    d = jnp.where(bad[:, None], -g, d)
+    gd = jnp.where(bad, -jnp.sum(g * g, axis=-1), gd)
+    zd = jnp.einsum("brs,bs->br", x, d)
+    ts = jnp.asarray(TS)
+    z_t = z[None] + ts[:, None, None] * zd[None]
+    loss_t = jnp.logaddexp(0.0, z_t) - z_t * y[None]
+    w_t = w[None] + ts[:, None, None] * d[None]
+    f_t = jnp.sum(wt[None] * loss_t, axis=-1) + 0.5 * jnp.sum(
+        l2[None] * (w_t - mt[None]) ** 2, axis=-1)
+    armijo = f_t <= f[None] + 1e-4 * ts[:, None] * gd[None]
+    first = jnp.argmax(armijo, axis=0)
+    any_ok = jnp.any(armijo, axis=0)
+    t_sel = ts[first]
+    f_sel = jnp.take_along_axis(f_t, first[None], axis=0)[0]
+    improved = any_ok & (f_sel < f)
+    w_new = jnp.where(improved[:, None], w + t_sel[:, None] * d, w)
+    z2 = jnp.einsum("brs,bs->br", x, w_new) + off
+    f_new = jnp.sum(wt * (jnp.logaddexp(0.0, z2) - z2 * y), axis=-1) \
+        + 0.5 * jnp.sum(l2 * (w_new - mt) ** 2, axis=-1)
+    p2 = jax.nn.sigmoid(z2)
+    g_new = (jnp.einsum("brs,br->bs", x, wt * (p2 - y))
+             + l2 * (w_new - mt)) * vm
+    return w_new, f_new[:, None], g_new, improved.astype(jnp.float32)[:, None]
+
+
+def main():
+    rng = np.random.default_rng(0)
+    bpad = (B // BT) * BT
+    x = jnp.asarray(rng.normal(size=(bpad, R, S)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(bpad, S)).astype(np.float32) * 0.1)
+    y = jnp.asarray((rng.random((bpad, R)) > 0.5).astype(np.float32))
+    wt = jnp.asarray(rng.random((bpad, R)).astype(np.float32))
+    off = jnp.zeros((bpad, R), jnp.float32)
+    l2 = jnp.ones((bpad, S), jnp.float32)
+    mt = jnp.zeros((bpad, S), jnp.float32)
+    vm = jnp.ones((bpad, S), jnp.float32)
+    # consistent starting objective values
+    z = jnp.einsum("brs,bs->br", x, w)
+    f0 = jnp.sum(wt * (jnp.logaddexp(0.0, z) - z * y), axis=-1) \
+        + 0.5 * jnp.sum(l2 * w ** 2, axis=-1)
+    f = f0[:, None]
+
+    args = (x, w, y, wt, off, l2, mt, vm, f)
+    t0 = time.perf_counter()
+    outs_p = pallas_step(*args)
+    print(f"pallas compile+run: {time.perf_counter() - t0:.1f}s",
+          flush=True)
+    outs_x = xla_step(*args)
+    for a, b, name in zip(outs_p, outs_x, ("w", "f", "g", "imp")):
+        err = float(jnp.max(jnp.abs(a - b)))
+        rel = err / (float(jnp.max(jnp.abs(b))) + 1e-30)
+        print(f"parity {name}: max abs {err:.3e} rel {rel:.3e}",
+              flush=True)
+
+    for name, fn in (("pallas", pallas_step), ("xla", xla_step)):
+        float(np.asarray(fn(*args)[1]).sum())
+        t0 = time.perf_counter()
+        for _ in range(5):
+            float(np.asarray(fn(*args)[1]).sum())
+        print(f"{name}: {(time.perf_counter() - t0) / 5 * 1000:.1f} ms "
+              "per Newton step", flush=True)
+
+
+if __name__ == "__main__":
+    main()

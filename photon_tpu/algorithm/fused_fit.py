@@ -58,6 +58,8 @@ from photon_tpu.models.game import (
     _bucket_score_add,
     _passive_score_set_dense,
     _passive_score_set_sparse,
+    _score_raw_dense,
+    _score_raw_sparse,
     score_raw_features,
 )
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
@@ -325,7 +327,10 @@ class FusedFit:
                     )
                     for i in range(meta["n_blocks"])
                 ]
-                proj_dev = arrays[-1]
+                # Layout contract (build_random_effect_dataset): the
+                # projector sits at 5*n_blocks; trailing arrays (the
+                # score map) come AFTER it — arrays[-1] would pick those.
+                proj_dev = arrays[5 * meta["n_blocks"]]
             else:
                 plans = list(op["plans"])
                 proj_dev = op["proj_dev"]
@@ -337,6 +342,15 @@ class FusedFit:
                     for p in plans
                 ),
                 "proj_dev": proj_dev,
+                # Inverse score map (row -> flat bucket/passive score
+                # position): present on packed layouts with the extra
+                # trailing array; enables the gather-based scorer.
+                "score_inv": (
+                    arrays[5 * meta["n_blocks"] + 1]
+                    if "buf" in op
+                    and len(meta["slices"]) == 5 * meta["n_blocks"] + 2
+                    else None
+                ),
             }
         return out
 
@@ -485,7 +499,11 @@ class FusedFit:
     def _re_score(self, w, op, mat):
         """Model contribution per canonical row (active+passive), traced.
 
-        Mirrors models/game.py _score_via_buckets with operand arrays."""
+        With a packed score map this is scatter-FREE: per-bucket score
+        blocks and the passive-row scores concatenate into one flat
+        vector that a single gather distributes to canonical rows (a
+        TPU scatter-add of the same pass measured ~4x slower). Otherwise
+        mirrors models/game.py _score_via_buckets."""
         from photon_tpu.data.dataset import DenseFeatures
 
         n = op["score_codes"].shape[0]
@@ -494,6 +512,30 @@ class FusedFit:
             # ELL fallback bucket present: score straight off the raw shard.
             return score_raw_features(
                 w, op["score_codes"], op["raw"], proj_dev)
+        if mat.get("score_inv") is not None:
+            parts = []
+            for eb in mat["ebs"]:
+                we = jnp.take(
+                    w, eb.entity_codes, axis=0, mode="clip"
+                )[:, :eb.x_values.shape[-1]].astype(eb.x_values.dtype)
+                zb = jnp.einsum("brs,bs->br", eb.x_values, we)
+                parts.append(zb.reshape(-1))
+            if op["passive"] is not None:
+                pr = op["passive"]
+                codes_p = jnp.take(op["score_codes"], pr)
+                if isinstance(op["raw"], DenseFeatures):
+                    zp = _score_raw_dense(
+                        w, codes_p, jnp.take(op["raw"].x, pr, axis=0),
+                        proj_dev)
+                else:
+                    zp = _score_raw_sparse(
+                        w, codes_p,
+                        jnp.take(op["raw"].indices, pr, axis=0),
+                        jnp.take(op["raw"].values, pr, axis=0),
+                        proj_dev)
+                parts.append(zp.astype(parts[0].dtype))
+            flat = jnp.concatenate(parts)
+            return jnp.take(flat, mat["score_inv"], mode="clip")
         z = jnp.zeros(n, dtype=w.dtype)
         for (row_ids, row_counts, codes), eb in zip(
             mat["score_plans"], mat["ebs"]

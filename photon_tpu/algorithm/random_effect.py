@@ -486,6 +486,77 @@ def _solve_newton_batched(
     w0 = to_transformed(w0_orig) * valid_mask
     f0, g0 = objective(w0)
     max_iters = opt_config.max_iterations
+
+    from photon_tpu.ops import newton_kernel as nk
+
+    r = x.shape[1]
+    if nk.kernel_supported(task, dtype, r, sub_dim):
+        # Fused Pallas step: the [S, S] Hessians never leave VMEM (the
+        # XLA path's padded [B, S, S] HBM round trip was the dominant
+        # per-iteration traffic; ops/newton_kernel.py, 3.1x measured).
+        bp = nk.pad_lanes(b)
+
+        def pad_b(a):
+            return jnp.pad(a, [(0, bp - b)] + [(0, 0)] * (a.ndim - 1))
+
+        x_l = jnp.transpose(pad_b(x), (2, 1, 0))
+        y_l = nk.to_lanes(labels, bp)
+        wt_l = nk.to_lanes(weights, bp)
+        off_l = nk.to_lanes(offsets, bp)
+        l2_l = nk.to_lanes(jnp.broadcast_to(l2_diag, (b, sub_dim)), bp)
+        mt_l = nk.to_lanes(jnp.broadcast_to(m_t, (b, sub_dim)), bp)
+        vm_l = nk.to_lanes(valid_mask, bp)
+        w_l = nk.to_lanes(w0, bp)
+        g_l = nk.to_lanes(g0, bp)
+        f_l = jnp.pad(f0, (0, bp - b))[None, :]
+        tol_p = optim.Tolerances(
+            loss_abs=jnp.pad(tol.loss_abs, (0, bp - b)),
+            gradient_abs=jnp.pad(tol.gradient_abs, (0, bp - b)),
+        )
+
+        def cond_k(st):
+            return jnp.any(st[4] == 0)
+
+        def body_k(st):
+            w_c, f_c, g_c, it_c, code_c = st
+            active = code_c == 0
+            w_n, f_n, g_n, imp = nk.newton_step_lanes(
+                x_l, w_c, y_l, wt_l, off_l, l2_l, mt_l, vm_l, f_c,
+                r=r, s=sub_dim, task=task,
+            )
+            w_n = jnp.where(active[None, :], w_n, w_c)
+            f_n = jnp.where(active[None, :], f_n, f_c)
+            g_n = jnp.where(active[None, :], g_n, g_c)
+            it_n = jnp.where(active, it_c + 1, it_c)
+            code_n = optim.convergence_code(
+                iteration=it_n,
+                max_iterations=max_iters,
+                loss_delta=f_c[0] - f_n[0],
+                gradient_norm=jnp.sqrt(jnp.sum(g_n * g_n, axis=0)),
+                tol=tol_p,
+                not_improving=~(imp[0] > 0),
+            )
+            code_n = jnp.where(active, code_n, code_c)
+            return w_n, f_n, g_n, it_n, code_n
+
+        w_lk, _, _, iters_k, reason_k = lax.while_loop(
+            cond_k, body_k,
+            (w_l, f_l, g_l, jnp.zeros(bp, jnp.int32),
+             jnp.zeros(bp, jnp.int32)),
+        )
+        w_t = jnp.transpose(w_lk)[:b] * valid_mask
+        iters = iters_k[:b]
+        reason = reason_k[:b]
+        if variance_computation != VarianceComputationType.NONE:
+            variances = _batched_variances(
+                x, labels, offsets, weights, w_t, l2_diag, valid_mask,
+                factors, shifts, loss, variance_computation,
+            )
+        else:
+            variances = jnp.zeros_like(w_t)
+        w_orig = to_original(w_t) * valid_mask
+        return w_orig, variances, iters, reason
+
     trial_ts = 0.5 ** jnp.arange(
         _NEWTON_LINE_SEARCH_HALVINGS + 1, dtype=dtype
     )  # [T]

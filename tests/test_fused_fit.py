@@ -157,6 +157,58 @@ class TestFusedWarmStartAndGrid:
                 f[cid], w[cid], rtol=5e-2, atol=1e-3, err_msg=cid)
 
 
+class TestFusedPassiveRows:
+    def test_capped_reservoir_matches_unfused(self, rng):
+        """A binding active_data_upper_bound creates passive rows, which
+        route the fused scorer through the projector table (review
+        regression: the packed layout's trailing score map was read as
+        the projector)."""
+        game = _game(rng, "linear", n=900, E=12)
+        cfg = GLMOptimizationConfiguration(
+            regularization=optim.RegularizationContext(
+                optim.RegularizationType.L2),
+            regularization_weight=0.5,
+        )
+        from photon_tpu.data.random_effect import (
+            RandomEffectDataConfiguration,
+        )
+
+        def est_of():
+            return GameEstimator(
+                TaskType.LINEAR_REGRESSION,
+                {
+                    "global": FixedEffectCoordinateConfiguration(
+                        "global", _l2(0.01)),
+                    "per-user": RandomEffectCoordinateConfiguration(
+                        RandomEffectDataConfiguration(
+                            "userId", "userShard",
+                            active_data_upper_bound=20,  # binds: ~75/entity
+                        ),
+                        cfg,
+                    ),
+                },
+                intercept_indices={"global": 5, "userShard": 3},
+                num_iterations=2,
+                mesh=None,
+            )
+
+        est_f = est_of()
+        r_f = est_f.fit(game)[0]
+        assert est_f._fused_cache is not None, "fused path did not run"
+        ds = est_f._fit_cache[1][0]["per-user"]
+        _, passive = ds.covered_row_partition()
+        assert passive.size > 0, "cap must create passive rows"
+        est_u = est_of()
+        from photon_tpu.events import EventEmitter
+
+        est_u.emitter = EventEmitter([lambda e: None])
+        r_u = est_u.fit(game)[0]
+        for cid in ("global", "per-user"):
+            f, u = _coef_maps(r_f), _coef_maps(r_u)
+            np.testing.assert_allclose(
+                f[cid], u[cid], rtol=1e-8, atol=1e-10, err_msg=cid)
+
+
 class TestFusedLockedCoordinates:
     def test_partial_retrain_matches_unfused(self, rng):
         """Locked (partial-retrain) coordinates ride the fused path:
