@@ -533,7 +533,9 @@ class FusedFit:
                         jnp.take(op["raw"].indices, pr, axis=0),
                         jnp.take(op["raw"].values, pr, axis=0),
                         proj_dev)
-                parts.append(zp.astype(parts[0].dtype))
+                parts.append(zp.astype(w.dtype))
+            if not parts:  # no active entities AND no passive rows
+                return jnp.zeros(n, dtype=w.dtype)
             flat = jnp.concatenate(parts)
             return jnp.take(flat, mat["score_inv"], mode="clip")
         z = jnp.zeros(n, dtype=w.dtype)

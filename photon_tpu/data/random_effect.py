@@ -1401,22 +1401,6 @@ def build_random_effect_dataset(
     covered_np = np.zeros(plan.codes.shape[0], dtype=bool)
     for bh in bucket_host:
         covered_np[bh["rows_flat"]] = True
-    # Inverse score map: canonical row -> flat position in the
-    # concatenation of all buckets' [B, cap] score blocks followed by the
-    # passive-row score vector. Scoring then becomes ONE gather —
-    # scatter-adds of bucket scores into [n] cost ~4x more on TPU
-    # (measured 51ms vs 13ms per pass at bench shapes).
-    score_inv_np = np.empty(plan.codes.shape[0], dtype=np.int32)
-    base = 0
-    for bh in bucket_host:
-        cap = bh["brow"].shape[1]
-        score_inv_np[bh["rows_flat"]] = (
-            base + bh["t_of"] * cap + bh["r_of"]
-        ).astype(np.int32)
-        base += bh["brow"].size
-    passive_rows = np.nonzero(~covered_np)[0]
-    score_inv_np[passive_rows] = base + np.arange(
-        passive_rows.size, dtype=np.int32)
 
     ell_idx = ell_val = ell_tail = None
     if not lazy:
@@ -1429,6 +1413,24 @@ def build_random_effect_dataset(
     weights_np = game_data.host_column("weights")
 
     if lazy:
+        # Inverse score map: canonical row -> flat position in the
+        # concatenation of all buckets' [B, cap] score blocks followed by
+        # the passive-row score vector. Scoring then becomes ONE gather —
+        # scatter-adds of bucket scores into [n] cost ~4x more on TPU
+        # (measured 51ms vs 13ms per pass at bench shapes). Lazy-path
+        # only: the materialized layout scores through its remapped table.
+        score_inv_np = np.empty(plan.codes.shape[0], dtype=np.int32)
+        base = 0
+        for bh in bucket_host:
+            cap = bh["brow"].shape[1]
+            score_inv_np[bh["rows_flat"]] = (
+                base + bh["t_of"] * cap + bh["r_of"]
+            ).astype(np.int32)
+            base += bh["brow"].size
+        passive_rows = np.nonzero(~covered_np)[0]
+        score_inv_np[passive_rows] = base + np.arange(
+            passive_rows.size, dtype=np.int32)
+
         # ONE batched device_put for every plan array of every bucket.
         # Layout contract (device_plans / proj_device / the fused mat
         # program all index it): 5 arrays per bucket, then the [E, S]

@@ -74,10 +74,15 @@ def _loss_terms(task: TaskType, z, y):
     """(loss, dz, dzz) elementwise — mirrors ops/losses.py for the two
     strictly convex smooth losses the Newton path serves."""
     if task == TaskType.LOGISTIC_REGRESSION:
+        # Labels may arrive as {0,1} OR {-1,1}: anything above the
+        # positive-response threshold counts as positive, exactly as
+        # ops/losses.py (MathConst.POSITIVE_RESPONSE_THRESHOLD = 0.5).
+        ind = jnp.where(y > 0.5, 1.0, 0.0)
         p = 1.0 / (1.0 + jnp.exp(-z))
-        loss = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(z, 0.0) - z * y
-        return loss, p - y, p * (1 - p)
-    # Poisson: loss = exp(z) - y z
+        loss = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(z, 0.0) \
+            - z * ind
+        return loss, p - ind, p * (1 - p)
+    # Poisson: loss = exp(z) - y z (raw counts; PoissonLossFunction.scala)
     ez = jnp.exp(z)
     return ez - y * z, ez - y, ez
 

@@ -71,14 +71,25 @@ def _reference_step(task, x, w, y, wt, off, l2, mt, vm, f):
 
 
 @pytest.mark.parametrize(
-    "task", [TaskType.LOGISTIC_REGRESSION, TaskType.POISSON_REGRESSION]
+    "task,labels",
+    [
+        (TaskType.LOGISTIC_REGRESSION, "01"),
+        # {-1,1} labels: the positive-response threshold must apply
+        # inside the kernel exactly as in ops/losses.py (review
+        # regression: raw labels silently fit a different model).
+        (TaskType.LOGISTIC_REGRESSION, "pm1"),
+        (TaskType.POISSON_REGRESSION, "counts"),
+    ],
 )
-def test_kernel_matches_xla_step(rng, task):
+def test_kernel_matches_xla_step(rng, task, labels):
     b, r, s = 37, 8, 5
     x = rng.normal(size=(b, r, s)).astype(np.float32)
     w = (rng.normal(size=(b, s)) * 0.1).astype(np.float32)
-    if task == TaskType.POISSON_REGRESSION:
+    if labels == "counts":
         y = rng.poisson(1.0, size=(b, r)).astype(np.float32)
+    elif labels == "pm1":
+        y = np.where(rng.random((b, r)) > 0.5, 1.0, -1.0).astype(
+            np.float32)
     else:
         y = (rng.random((b, r)) > 0.5).astype(np.float32)
     wt = rng.random((b, r)).astype(np.float32) + 0.5
