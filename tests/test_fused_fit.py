@@ -44,6 +44,9 @@ def _game(rng, task="linear", n=600, d=6, du=4, E=15):
     z = x @ w + np.einsum("nd,nd->n", xu, wu[users])
     if task == "logistic":
         y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(np.float64)
+    elif task == "poisson":
+        y = rng.poisson(np.exp(np.clip(0.3 * z, None, 3.0))).astype(
+            np.float64)
     else:
         y = z + 0.1 * rng.normal(size=n)
     return make_game_dataset(
@@ -56,8 +59,10 @@ def _game(rng, task="linear", n=600, d=6, du=4, E=15):
 
 
 def _estimator(task, *, mesh, num_iterations=3):
-    tt = (TaskType.LOGISTIC_REGRESSION if task == "logistic"
-          else TaskType.LINEAR_REGRESSION)
+    tt = {
+        "logistic": TaskType.LOGISTIC_REGRESSION,
+        "poisson": TaskType.POISSON_REGRESSION,
+    }.get(task, TaskType.LINEAR_REGRESSION)
     return GameEstimator(
         tt,
         {
@@ -82,7 +87,7 @@ def _coef_maps(result):
     return out
 
 
-@pytest.mark.parametrize("task", ["linear", "logistic"])
+@pytest.mark.parametrize("task", ["linear", "logistic", "poisson"])
 class TestFusedUnfusedParity:
     def test_models_match(self, rng, task):
         game = _game(rng, task)

@@ -11,8 +11,13 @@ import numpy as np
 import pytest
 from jax import lax
 
+from photon_tpu.algorithm.random_effect import _NEWTON_LINE_SEARCH_HALVINGS
 from photon_tpu.ops import newton_kernel as nk
 from photon_tpu.types import TaskType
+
+# The trial count production actually runs (threaded through the kernel
+# call in _solve_newton_batched); the reference step below must match.
+TRIALS = _NEWTON_LINE_SEARCH_HALVINGS + 1
 
 
 def _reference_step(task, x, w, y, wt, off, l2, mt, vm, f):
@@ -49,7 +54,7 @@ def _reference_step(task, x, w, y, wt, off, l2, mt, vm, f):
     d = jnp.where(bad[:, None], -g, d)
     gd = jnp.where(bad, -jnp.sum(g * g, axis=-1), gd)
     zd = jnp.einsum("brs,bs->br", x, d)
-    ts = 0.5 ** jnp.arange(16, dtype=x.dtype)
+    ts = 0.5 ** jnp.arange(TRIALS, dtype=x.dtype)
     z_t = z[None] + ts[:, None, None] * zd[None]
     loss_t = loss.loss(z_t, y[None])
     w_t = w[None] + ts[:, None, None] * d[None]
@@ -127,7 +132,7 @@ def test_kernel_matches_xla_step(rng, task, labels):
         x_l, lanes2(w), lanes2(y), lanes2(wt), lanes2(off), lanes2(l2),
         lanes2(mt), lanes2(vm),
         jnp.asarray(np.pad(f0, (0, bp - b))[None, :]),
-        r=r, s=s, task=task, interpret=True,
+        r=r, s=s, task=task, trials=TRIALS, interpret=True,
     )
     w_k = np.asarray(out[0]).T[:b]
     f_k = np.asarray(out[1])[0, :b]
