@@ -58,8 +58,8 @@ from photon_tpu.models.game import (
     _bucket_score_add,
     _passive_score_set_dense,
     _passive_score_set_sparse,
-    _score_raw_dense,
-    _score_raw_sparse,
+    bucket_score_parts,
+    passive_raw_scores,
     score_raw_features,
 )
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
@@ -300,7 +300,13 @@ class FusedFit:
         arrays (static offsets — free in-trace), rebuild the BlockPlans,
         gather the [B, R, S] slabs, and emit (EntityBlocks, scoring plan
         arrays, projector table) — everything later fits consume."""
-        from photon_tpu.data.random_effect import BlockPlan
+        from photon_tpu.data.random_effect import (
+            PLAN_ARRAYS_PER_BUCKET as _PPB,
+            BlockPlan,
+            packed_len_with_score_inv,
+            packed_proj_index,
+            packed_score_inv_index,
+        )
 
         out = {}
         for cid, op in mat_ops.items():
@@ -315,11 +321,11 @@ class FusedFit:
                     )
                 plans = [
                     BlockPlan(
-                        entity_codes=arrays[5 * i],
-                        row_ids=arrays[5 * i + 1],
-                        row_counts=arrays[5 * i + 2],
-                        proj=arrays[5 * i + 3],
-                        intercept_slots=arrays[5 * i + 4],
+                        entity_codes=arrays[_PPB * i],
+                        row_ids=arrays[_PPB * i + 1],
+                        row_counts=arrays[_PPB * i + 2],
+                        proj=arrays[_PPB * i + 3],
+                        intercept_slots=arrays[_PPB * i + 4],
                         raw=op["raw"],
                         raw_labels=op["labels"],
                         raw_offsets=op["offsets"],
@@ -330,7 +336,7 @@ class FusedFit:
                 # Layout contract (build_random_effect_dataset): the
                 # projector sits at 5*n_blocks; trailing arrays (the
                 # score map) come AFTER it — arrays[-1] would pick those.
-                proj_dev = arrays[5 * meta["n_blocks"]]
+                proj_dev = arrays[packed_proj_index(meta["n_blocks"])]
             else:
                 plans = list(op["plans"])
                 proj_dev = op["proj_dev"]
@@ -346,9 +352,10 @@ class FusedFit:
                 # position): present on packed layouts with the extra
                 # trailing array; enables the gather-based scorer.
                 "score_inv": (
-                    arrays[5 * meta["n_blocks"] + 1]
+                    arrays[packed_score_inv_index(meta["n_blocks"])]
                     if "buf" in op
-                    and len(meta["slices"]) == 5 * meta["n_blocks"] + 2
+                    and len(meta["slices"])
+                    == packed_len_with_score_inv(meta["n_blocks"])
                     else None
                 ),
             }
@@ -513,31 +520,20 @@ class FusedFit:
             return score_raw_features(
                 w, op["score_codes"], op["raw"], proj_dev)
         if mat.get("score_inv") is not None:
-            parts = []
-            for eb in mat["ebs"]:
-                we = jnp.take(
-                    w, eb.entity_codes, axis=0, mode="clip"
-                )[:, :eb.x_values.shape[-1]].astype(eb.x_values.dtype)
-                zb = jnp.einsum("brs,bs->br", eb.x_values, we)
-                parts.append(zb.reshape(-1))
+            parts = bucket_score_parts(
+                w,
+                tuple(eb.x_values for eb in mat["ebs"]),
+                tuple(eb.entity_codes for eb in mat["ebs"]),
+            )
             if op["passive"] is not None:
-                pr = op["passive"]
-                codes_p = jnp.take(op["score_codes"], pr)
-                if isinstance(op["raw"], DenseFeatures):
-                    zp = _score_raw_dense(
-                        w, codes_p, jnp.take(op["raw"].x, pr, axis=0),
-                        proj_dev)
-                else:
-                    zp = _score_raw_sparse(
-                        w, codes_p,
-                        jnp.take(op["raw"].indices, pr, axis=0),
-                        jnp.take(op["raw"].values, pr, axis=0),
-                        proj_dev)
-                parts.append(zp.astype(w.dtype))
+                parts.append(passive_raw_scores(
+                    w, op["passive"], op["score_codes"], op["raw"],
+                    proj_dev))
             if not parts:  # no active entities AND no passive rows
                 return jnp.zeros(n, dtype=w.dtype)
             flat = jnp.concatenate(parts)
-            return jnp.take(flat, mat["score_inv"], mode="clip")
+            return jnp.take(
+                flat, mat["score_inv"], mode="clip").astype(w.dtype)
         z = jnp.zeros(n, dtype=w.dtype)
         for (row_ids, row_counts, codes), eb in zip(
             mat["score_plans"], mat["ebs"]

@@ -380,11 +380,11 @@ class RandomEffectDataset:
             out = tuple(
                 dataclasses.replace(
                     b,
-                    entity_codes=devs[5 * i],
-                    row_ids=devs[5 * i + 1],
-                    row_counts=devs[5 * i + 2],
-                    proj=devs[5 * i + 3],
-                    intercept_slots=devs[5 * i + 4],
+                    entity_codes=devs[PLAN_ARRAYS_PER_BUCKET * i],
+                    row_ids=devs[PLAN_ARRAYS_PER_BUCKET * i + 1],
+                    row_counts=devs[PLAN_ARRAYS_PER_BUCKET * i + 2],
+                    proj=devs[PLAN_ARRAYS_PER_BUCKET * i + 3],
+                    intercept_slots=devs[PLAN_ARRAYS_PER_BUCKET * i + 4],
                 )
                 for i, b in enumerate(self.blocks)
             )
@@ -397,16 +397,35 @@ class RandomEffectDataset:
             out = tuple(
                 dataclasses.replace(
                     b,
-                    entity_codes=leaves[5 * i],
-                    row_ids=leaves[5 * i + 1],
-                    row_counts=leaves[5 * i + 2],
-                    proj=leaves[5 * i + 3],
-                    intercept_slots=leaves[5 * i + 4],
+                    entity_codes=leaves[PLAN_ARRAYS_PER_BUCKET * i],
+                    row_ids=leaves[PLAN_ARRAYS_PER_BUCKET * i + 1],
+                    row_counts=leaves[PLAN_ARRAYS_PER_BUCKET * i + 2],
+                    proj=leaves[PLAN_ARRAYS_PER_BUCKET * i + 3],
+                    intercept_slots=leaves[PLAN_ARRAYS_PER_BUCKET * i + 4],
                 )
                 for i, b in enumerate(self.blocks)
             )
         object.__setattr__(self, "_device_plans", out)
         return out
+
+    def score_inv_device(self) -> Array | None:
+        """[n] int32 inverse score map (device), or None when absent.
+
+        Maps each canonical row to its flat position in the concatenation
+        of all buckets' [B, cap] score blocks followed by the passive-row
+        score vector — the scatter-free scoring contract (trailing array
+        of the packed plan layout)."""
+        if self.packed_view is None:
+            return None
+        n_blocks = len(self.blocks)
+        if len(self.packed_view) != packed_len_with_score_inv(n_blocks):
+            return None  # pre-score-map packed layout
+        cached = getattr(self, "_score_inv_cache", None)
+        if cached is None:
+            cached = self.packed_view.device_arrays()[
+                packed_score_inv_index(n_blocks)]
+            object.__setattr__(self, "_score_inv_cache", cached)
+        return cached
 
     def proj_device(self) -> Array:
         """[E, max_sub_dim] int32 device projector table (cached)."""
@@ -416,7 +435,7 @@ class RandomEffectDataset:
         if cached is None:
             if self.packed_view is not None:
                 cached = self.packed_view.device_arrays()[
-                    5 * len(self.blocks)]
+                    packed_proj_index(len(self.blocks))]
             else:
                 cached = jnp.asarray(self.proj_all.astype(np.int32))
             object.__setattr__(self, "_proj_dev_cache", cached)
@@ -993,6 +1012,26 @@ def _split_packed_impl(buf, shapes):
 
 
 _split_packed = jax.jit(_split_packed_impl, static_argnames=("shapes",))
+
+
+# Packed-plan layout contract (build_random_effect_dataset's lazy branch):
+# PLAN_ARRAYS_PER_BUCKET arrays per bucket (members, row_ids, counts, proj,
+# intercepts), then the [E, S] projector table, then the score gather map.
+# Every consumer (device_plans, proj_device, score_inv_device, the fused
+# materialization program) indexes through these helpers.
+PLAN_ARRAYS_PER_BUCKET = 5
+
+
+def packed_proj_index(n_blocks: int) -> int:
+    return PLAN_ARRAYS_PER_BUCKET * n_blocks
+
+
+def packed_score_inv_index(n_blocks: int) -> int:
+    return PLAN_ARRAYS_PER_BUCKET * n_blocks + 1
+
+
+def packed_len_with_score_inv(n_blocks: int) -> int:
+    return PLAN_ARRAYS_PER_BUCKET * n_blocks + 2
 
 
 class PackedPlanArrays:

@@ -142,13 +142,26 @@ def _score_via_buckets(w: Array, ds: RandomEffectDataset) -> Array | None:
     for plan, eb in zip(plans, blocks):
         if eb is plan or getattr(eb, "x_indices", True) is not None:
             return None
+    _, passive = ds.covered_row_partition()
+    inv = ds.score_inv_device()
+    if inv is not None and (blocks or passive.size):
+        # Scatter-free path (same contract as the fused fit's scorer):
+        # bucket score blocks + passive scores concatenate into one flat
+        # vector that a single gather distributes — TPU scatter-adds of
+        # the same pass measured ~4x slower. Empty datasets (no buckets,
+        # no passive rows) fall through to the zeros below.
+        slabs = tuple(eb.x_values for eb in blocks)
+        codes = tuple(p.entity_codes for p in plans)
+        pr = jnp.asarray(passive) if passive.size else None
+        return _gather_score(
+            w, slabs, codes, inv, pr, ds.score_codes, ds.raw,
+            ds.proj_device())
     z = jnp.zeros(ds.num_rows, dtype=w.dtype)
     for plan, eb in zip(plans, blocks):
         z = _bucket_score_add(
             z, eb.x_values, plan.row_ids, plan.row_counts,
             plan.entity_codes, w,
         )
-    _, passive = ds.covered_row_partition()
     if passive.size:
         pr = jnp.asarray(passive)
         feats = ds.raw
@@ -162,6 +175,48 @@ def _score_via_buckets(w: Array, ds: RandomEffectDataset) -> Array | None:
                 w, ds.proj_device(),
             )
     return z
+
+
+def bucket_score_parts(w, slabs, codes):
+    """Per-bucket flat [B*cap] score vectors (slab GEMM per bucket)."""
+    parts = []
+    for xv, cd in zip(slabs, codes):
+        we = jnp.take(w, cd, axis=0, mode="clip")[:, :xv.shape[-1]].astype(
+            xv.dtype)
+        parts.append(jnp.einsum("brs,bs->br", xv, we).reshape(-1))
+    return parts
+
+
+def passive_raw_scores(w, pr, score_codes, feats, proj_dev):
+    """Raw-feature scores for the passive row subset ``pr`` (traceable).
+
+    Computed in the COEFFICIENT dtype — passive rows must not round
+    through a lower slab dtype on their way into the final gather."""
+    from photon_tpu.data.dataset import DenseFeatures
+
+    codes_p = jnp.take(score_codes, pr)
+    if isinstance(feats, DenseFeatures):
+        zp = _score_raw_dense(
+            w, codes_p, jnp.take(feats.x, pr, axis=0), proj_dev)
+    else:
+        zp = _score_raw_sparse(
+            w, codes_p, jnp.take(feats.indices, pr, axis=0),
+            jnp.take(feats.values, pr, axis=0), proj_dev,
+        )
+    return zp.astype(w.dtype)
+
+
+@jax.jit
+def _gather_score(w, slabs, codes, inv, pr, score_codes, feats, proj_dev):
+    """ONE gather distributes concatenated bucket + passive scores to
+    canonical rows (the scatter-free scoring contract; shared shape with
+    fused_fit._re_score)."""
+    parts = bucket_score_parts(w, slabs, codes)
+    if pr is not None:
+        parts.append(passive_raw_scores(w, pr, score_codes, feats,
+                                        proj_dev))
+    return jnp.take(
+        jnp.concatenate(parts), inv, mode="clip").astype(w.dtype)
 
 
 @jax.jit
