@@ -82,11 +82,17 @@ class CoordinateUpdateRecord:
     is not attributable per coordinate. End-to-end wall time lives at the
     fit / driver level, where the caller's first blocking read (evaluation,
     model save) absorbs the queued work.
+
+    On the FUSED whole-fit path (algorithm/fused_fit.py) the entire
+    descent is one device program, so not even dispatch time exists per
+    coordinate: ``seconds`` is ``None`` there, and the total lives on the
+    fit result / driver timings. Consumers must treat ``None`` as
+    "unattributable", not zero.
     """
 
     iteration: int
     coordinate_id: str
-    seconds: float  # host dispatch time (see class docstring)
+    seconds: float | None  # host dispatch time; None on the fused path
     diagnostics: Any
     evaluation: EvaluationResults | None
 

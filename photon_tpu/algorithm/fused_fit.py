@@ -27,7 +27,6 @@ host-driven). Everything else falls back to ``CoordinateDescent``.
 from __future__ import annotations
 
 import dataclasses
-import time
 
 import jax
 import jax.numpy as jnp
@@ -221,6 +220,7 @@ class FusedFit:
         update_sequence: list[str],
         num_iterations: int,
         locked_coordinates: set[str] | None = None,
+        mat_share: dict | None = None,
     ):
         self.seq = list(update_sequence)
         self.num_iterations = num_iterations
@@ -283,6 +283,13 @@ class FusedFit:
         # backend.
         self._mat_jit = jax.jit(self._mat_fn)
         self._mat_cache: dict | None = None
+        # Optional slab share across FusedFit instances (passed by the
+        # estimator's program cache): the materialized slabs depend only
+        # on the coordinate/dataset structure — identical for every
+        # static-key variant of one estimator generation — so cached
+        # sibling programs must reference ONE copy, not pin one per
+        # optimizer config.
+        self._mat_shared = mat_share
         # Zero warm-start tables, created once per generation: an eager
         # jnp.zeros([100k, S]) costs a ~250ms device round trip on the
         # tunneled backend, which would otherwise recur on every fit.
@@ -718,15 +725,22 @@ class FusedFit:
         coords: dict[str, object],
         initial_models: dict[str, object] | None = None,
     ) -> CoordinateDescentResult:
-        t0 = time.perf_counter()
         ops = self._operands(coords, initial_models)
         statics = self._statics(coords, initial_models)
         # Slabs materialize once per dataset generation (separate cached
         # program that also unpacks the ingest's packed plan buffer);
         # every fit's program receives the results as plain operands.
-        if self._mat_cache is None:
-            self._mat_cache = self._mat_jit(self._mat_operands(coords))
-        ebs_all = self._mat_cache
+        # When the estimator provides a share, sibling programs (other
+        # static keys of the same generation) reuse the same device slabs.
+        if self._mat_shared is not None:
+            ebs_all = self._mat_shared.get("ebs")
+            if ebs_all is None:
+                ebs_all = self._mat_shared["ebs"] = self._mat_jit(
+                    self._mat_operands(coords))
+        else:
+            if self._mat_cache is None:
+                self._mat_cache = self._mat_jit(self._mat_operands(coords))
+            ebs_all = self._mat_cache
         states, scores, total, packed_flat = self._jit(
             ops, ebs_all, statics=statics)
         # Diagnostic shapes, in the exact flattening order of _fit_fn's
@@ -749,13 +763,10 @@ class FusedFit:
 
         models: dict[str, object] = {}
         history: list[CoordinateUpdateRecord] = []
-        seconds = time.perf_counter() - t0
-        n_updates = max(
-            1,
-            self.num_iterations
-            * sum(1 for c in self.seq if self.kinds[c] != "locked"),
-        )
-        per_update = seconds / n_updates
+        # The whole descent is ONE device program here: per-coordinate time
+        # does not exist, not even as dispatch time. Records carry
+        # seconds=None (see CoordinateUpdateRecord) instead of a synthetic
+        # uniform split that consumers would read as measured.
         for i, cid in enumerate(self.seq):
             coord = coords[cid]
             kind = self.kinds[cid]
@@ -806,7 +817,7 @@ class FusedFit:
                 history.append(CoordinateUpdateRecord(
                     iteration=it,
                     coordinate_id=cid,
-                    seconds=per_update,
+                    seconds=None,
                     diagnostics=diag,
                     evaluation=None,
                 ))

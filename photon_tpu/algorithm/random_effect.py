@@ -524,6 +524,7 @@ def _solve_newton_batched(
                 x_l, w_c, y_l, wt_l, off_l, l2_l, mt_l, vm_l, f_c,
                 r=r, s=sub_dim, task=task,
                 trials=_NEWTON_LINE_SEARCH_HALVINGS + 1,
+                interpret=nk.interpret_required(),
             )
             w_n = jnp.where(active[None, :], w_n, w_c)
             f_n = jnp.where(active[None, :], f_n, f_c)

@@ -1,0 +1,54 @@
+"""photon_tpu.analysis — a JAX-aware static lint pass that gates the package.
+
+Pure-``ast`` (nothing analyzed is imported, no JAX needed at analysis
+time), so it runs in milliseconds on any machine. The rule set encodes the
+failure modes that silently destroy TPU performance or correctness and
+that this repo has actually hit: hidden host syncs inside jitted code,
+numpy-on-tracer calls, recompile-triggering jit misuse, float64 leaking
+into float32 pipelines, int32 index arithmetic near 2^31, and leftover
+debugging debris.
+
+Usage::
+
+    python -m photon_tpu.analysis photon_tpu/            # gate: exit 0/1
+    python -m photon_tpu.analysis --list-rules
+    python -m photon_tpu.analysis --format json photon_tpu/data/
+
+Per-line suppression (reason after ``--`` is part of the contract)::
+
+    y = labels.astype(np.float64)  # photon: ignore[float64-literal] -- host-side stats
+
+See ANALYSIS.md for every rule's rationale with its in-repo example.
+"""
+
+from photon_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    registered_rules,
+    rule,
+)
+from photon_tpu.analysis.report import (
+    render_json,
+    render_rule_list,
+    render_text,
+    summarize,
+)
+
+__all__ = [
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "registered_rules",
+    "rule",
+    "render_json",
+    "render_rule_list",
+    "render_text",
+    "summarize",
+]

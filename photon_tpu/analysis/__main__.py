@@ -1,0 +1,107 @@
+"""CLI: ``python -m photon_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (or only suppressed findings), 1 unsuppressed
+findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from photon_tpu.analysis.core import (
+    analyze_paths,
+    iter_python_files,
+    registered_rules,
+)
+from photon_tpu.analysis.report import (
+    render_json,
+    render_rule_list,
+    render_text,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m photon_tpu.analysis",
+        description="JAX-aware static lint pass for photon_tpu "
+        "(see ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to analyze (default: photon_tpu/)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="report format",
+    )
+    parser.add_argument(
+        "--select",
+        metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="include suppressed findings in text output",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the registered rules and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(render_rule_list())
+        return 0
+
+    paths = args.paths or ["photon_tpu"]
+    select = (
+        [r.strip() for r in args.select.split(",") if r.strip()]
+        if args.select
+        else None
+    )
+    if select is not None:
+        unknown = set(select) - set(registered_rules())
+        if unknown:
+            print(
+                f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                file=sys.stderr,
+            )
+            return 2
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(
+            f"no such path(s): {', '.join(missing)}", file=sys.stderr
+        )
+        return 2
+    try:
+        findings = analyze_paths(paths, select=select)
+    except OSError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    if not any(iter_python_files(paths)):
+        # A gate that analyzed zero files must not report "clean" — a
+        # wrong CWD or glob would make CI pass vacuously.
+        print(
+            "no Python files found under: " + ", ".join(map(str, paths)),
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        out = render_text(findings, show_suppressed=args.show_suppressed)
+        if out:
+            print(out)
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

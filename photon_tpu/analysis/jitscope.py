@@ -1,0 +1,641 @@
+"""Jit-scope discovery and a taint walk over traced values.
+
+Two questions every JAX-aware rule needs answered:
+
+1. **Which function bodies execute under tracing?** Functions decorated
+   with ``jax.jit`` / ``pjit`` (directly or through ``functools.partial``),
+   functions passed to ``jax.jit(fn, ...)`` by name (including
+   ``self.method`` resolved against the enclosing class), and the body /
+   cond / branch callables handed to ``lax.scan`` / ``while_loop`` /
+   ``fori_loop`` / ``cond`` / ``switch`` / ``map`` and ``jax.vmap`` /
+   ``jax.grad`` / ``jax.checkpoint``.
+
+2. **Which values inside such a body are tracers?** Parameters are the
+   taint sources — minus ``static_argnums`` / ``static_argnames``, which
+   are concrete Python values. Taint propagates through expressions and
+   assignments in statement order, and *stops* at the places JAX makes
+   static again: ``.shape`` / ``.dtype`` / ``.ndim`` / ``.size``,
+   ``len()`` / ``isinstance()`` / ``type()``, and ``is (not) None``
+   structure checks. This keeps ``for i in range(x.shape[0])`` and
+   ``if residuals is not None`` clean while ``if jnp.any(mask)`` flags.
+
+The walk is a deliberately simple single in-order pass (last writer wins)
+— the right fidelity for a linter: precise enough that the whole package
+carries only a handful of suppressions, cheap enough to run on every test
+invocation.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Iterator
+
+from photon_tpu.analysis.core import ModuleContext
+
+# Attribute reads that yield static (host) values even on a tracer.
+STATIC_ATTRS = frozenset(
+    {"shape", "dtype", "ndim", "size", "aval", "sharding", "weak_type"}
+)
+# Builtins whose result is host-static regardless of argument taint.
+STATIC_CALLS = frozenset(
+    {"isinstance", "issubclass", "hasattr", "len", "type", "id", "callable",
+     "repr"}
+)
+# Calling these on a tracer forces a host sync (or raises under trace).
+HOST_SYNC_CASTS = frozenset({"bool", "int", "float", "complex"})
+HOST_SYNC_METHODS = frozenset({"item", "tolist", "__bool__", "__index__"})
+
+_JIT_WRAPPERS = frozenset({"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"})
+# callable-argument positions for tracing entry points: name -> indices
+_TRACED_CALLEES: dict[str, tuple[int, ...]] = {
+    "jax.lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.lax.map": (0,),
+    "jax.vmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+}
+
+
+@dataclasses.dataclass
+class JitScope:
+    """A function body that runs under a JAX trace."""
+
+    node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+    why: str  # human-readable provenance for messages
+    static_argnums: frozenset[int] = frozenset()
+    static_argnames: frozenset[str] = frozenset()
+
+    def traced_params(self) -> set[str]:
+        args = self.node.args
+        positional = [*args.posonlyargs, *args.args]
+        traced: set[str] = set()
+        for i, a in enumerate(positional):
+            if i in self.static_argnums or a.arg in self.static_argnames:
+                continue
+            if a.arg in ("self", "cls"):
+                continue
+            traced.add(a.arg)
+        for a in args.kwonlyargs:
+            if a.arg not in self.static_argnames:
+                traced.add(a.arg)
+        if args.vararg is not None:
+            traced.add(args.vararg.arg)
+        return traced
+
+
+def _int_elems(node: ast.AST | None) -> frozenset[int]:
+    if node is None:
+        return frozenset()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+        return frozenset(out)
+    return frozenset()
+
+
+def _str_elems(node: ast.AST | None) -> frozenset[str]:
+    if node is None:
+        return frozenset()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+        return frozenset(out)
+    return frozenset()
+
+
+def _jit_statics(
+    call: ast.Call | None,
+) -> tuple[frozenset[int], frozenset[str]]:
+    """static_argnums / static_argnames from a jit(...) call's keywords."""
+    nums: frozenset[int] = frozenset()
+    names: frozenset[str] = frozenset()
+    if call is None:
+        return nums, names
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _int_elems(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _str_elems(kw.value)
+    return nums, names
+
+
+def _is_jit_expr(ctx: ModuleContext, node: ast.AST) -> ast.Call | None:
+    """``jax.jit`` / ``partial(jax.jit, ...)`` -> the call carrying statics.
+
+    Returns the ast.Call whose keywords hold static_argnums/argnames (the
+    partial call, or the jit call itself), or None when ``node`` is not a
+    jit wrapper expression. A bare ``jax.jit`` reference (no statics)
+    returns a synthetic empty marker via the enclosing caller.
+    """
+    if ctx.resolve(node) in _JIT_WRAPPERS:
+        return ast.Call(func=node, args=[], keywords=[])  # no statics
+    if isinstance(node, ast.Call):
+        path = ctx.resolve(node.func)
+        if path in _JIT_WRAPPERS:
+            return node
+        if path == "functools.partial" and node.args:
+            if ctx.resolve(node.args[0]) in _JIT_WRAPPERS:
+                return node
+    return None
+
+
+def _local_functions(
+    ctx: ModuleContext,
+) -> dict[ast.AST, dict[str, ast.FunctionDef]]:
+    """scope node -> {name: FunctionDef defined directly in that scope}."""
+    out: dict[ast.AST, dict[str, ast.FunctionDef]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            parent = ctx.parents.get(node)
+            # functions sit directly in Module / ClassDef / FunctionDef
+            out.setdefault(parent, {})[node.name] = node
+    return out
+
+
+def _resolve_callable(
+    ctx: ModuleContext,
+    funcs: dict[ast.AST, dict[str, ast.FunctionDef]],
+    ref: ast.AST,
+) -> ast.FunctionDef | ast.Lambda | None:
+    """Resolve a callable reference to its def, searching enclosing scopes."""
+    if isinstance(ref, ast.Lambda):
+        return ref
+    if isinstance(ref, ast.Name):
+        scope: ast.AST | None = ref
+        while scope is not None:
+            scope = next(
+                (
+                    a
+                    for a in ctx.parent_chain(scope)
+                    if isinstance(
+                        a,
+                        (ast.Module, ast.ClassDef, ast.FunctionDef,
+                         ast.AsyncFunctionDef),
+                    )
+                ),
+                None,
+            )
+            if scope is None:
+                return None
+            found = funcs.get(scope, {}).get(ref.id)
+            if found is not None:
+                return found
+        return None
+    # self.method -> method def on the nearest enclosing class
+    if (
+        isinstance(ref, ast.Attribute)
+        and isinstance(ref.value, ast.Name)
+        and ref.value.id == "self"
+    ):
+        for anc in ctx.parent_chain(ref):
+            if isinstance(anc, ast.ClassDef):
+                return funcs.get(anc, {}).get(ref.attr)
+    return None
+
+
+def find_jit_scopes(ctx: ModuleContext) -> list[JitScope]:
+    """Every function body in the module that executes under a trace.
+
+    Memoized on the context: several rules consult the scope list and
+    must not redo discovery per rule.
+    """
+    cached = getattr(ctx, "_jit_scopes_cache", None)
+    if cached is not None:
+        return cached
+    funcs = _local_functions(ctx)
+    scopes: dict[ast.AST, JitScope] = {}
+
+    def add(node, why, nums=frozenset(), names=frozenset()):
+        if node is not None and node not in scopes:
+            scopes[node] = JitScope(
+                node=node, why=why, static_argnums=nums, static_argnames=names
+            )
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                call = _is_jit_expr(ctx, deco)
+                if call is not None:
+                    nums, names = _jit_statics(call)
+                    add(node, "decorated with jax.jit", nums, names)
+        if not isinstance(node, ast.Call):
+            continue
+        # jax.jit(fn, ...) / partial(jax.jit, ...)(fn)
+        call = _is_jit_expr(ctx, node.func)
+        if call is not None and node.args:
+            target = _resolve_callable(ctx, funcs, node.args[0])
+            nums, names = _jit_statics(call)
+            n2, s2 = _jit_statics(node)
+            add(
+                target,
+                "wrapped by jax.jit",
+                nums | n2,
+                names | s2,
+            )
+            continue
+        path = ctx.resolve(node.func)
+        if path in _TRACED_CALLEES:
+            short = path.removeprefix("jax.")
+            for idx in _TRACED_CALLEES[path]:
+                if idx < len(node.args):
+                    add(
+                        _resolve_callable(ctx, funcs, node.args[idx]),
+                        f"passed to {short}",
+                    )
+    result = list(scopes.values())
+    ctx._jit_scopes_cache = result
+    return result
+
+
+# --------------------------------------------------------------------------
+# taint walk
+# --------------------------------------------------------------------------
+
+# Event kinds emitted to rule callbacks.
+HOST_SYNC = "host-sync"
+NUMPY_ON_TRACER = "numpy-on-tracer"
+
+# Sentinel: a plainly-tainted iteration element (vs structural False).
+PLAIN_TAINTED = True
+
+
+def _spec_any(spec) -> bool:
+    if isinstance(spec, list):
+        return any(_spec_any(s) for s in spec)
+    return bool(spec)
+
+EventFn = Callable[[str, ast.AST, str], None]
+
+
+class TaintWalker:
+    """Walk one jit scope, tracking tracer-reachable names.
+
+    ``on_event(kind, node, detail)`` fires for host-sync and
+    numpy-on-tracer hazards; rules wrap it into Findings.
+    """
+
+    def __init__(self, ctx: ModuleContext, scope: JitScope, on_event: EventFn):
+        self.ctx = ctx
+        self.scope = scope
+        self.on_event = on_event
+        self.tainted: set[str] = scope.traced_params()
+
+    # -- expression taint ------------------------------------------------
+
+    def is_tainted(self, node: ast.AST | None) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value) or self.is_tainted(node.slice)
+        if isinstance(node, ast.Call):
+            return self._call_tainted(node)
+        if isinstance(node, ast.Compare):
+            if all(
+                op.__class__ in (ast.Is, ast.IsNot, ast.In, ast.NotIn)
+                for op in node.ops
+            ):
+                # `is (not) None` and dict/key membership are pytree
+                # STRUCTURE, static under trace. (Membership in a traced
+                # *array* would be traced — rare enough to accept the
+                # miss; documented in ANALYSIS.md limitations.)
+                return False
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return any(
+                self.is_tainted(n) for n in (node.test, node.body, node.orelse)
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.is_tainted(v) for v in node.values) or any(
+                self.is_tainted(k) for k in node.keys if k is not None
+            )
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Slice):
+            return any(
+                self.is_tainted(n)
+                for n in (node.lower, node.upper, node.step)
+            )
+        if isinstance(node, ast.NamedExpr):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.JoinedStr):
+            return any(
+                self.is_tainted(v.value)
+                for v in node.values
+                if isinstance(v, ast.FormattedValue)
+            )
+        # Constants, lambdas (defined, not called), comprehensions: treat
+        # comprehensions as tainted when any iterable source is.
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return any(self.is_tainted(g.iter) for g in node.generators)
+        if isinstance(node, ast.DictComp):
+            return any(self.is_tainted(g.iter) for g in node.generators)
+        return False
+
+    def _call_tainted(self, node: ast.Call) -> bool:
+        if isinstance(node.func, ast.Name) and node.func.id in STATIC_CALLS:
+            return False
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in STATIC_ATTRS
+        ):
+            return False
+        parts = [
+            *(node.args),
+            *(kw.value for kw in node.keywords),
+        ]
+        if any(self.is_tainted(a) for a in parts):
+            return True
+        # method on a tracer returns a tracer (x.astype(...), x.sum(), ...)
+        if isinstance(node.func, ast.Attribute):
+            return self.is_tainted(node.func.value)
+        return False
+
+    # -- statement walk --------------------------------------------------
+
+    def run(self) -> None:
+        body = self.scope.node.body
+        if isinstance(self.scope.node, ast.Lambda):
+            self._check_expr(self.scope.node.body)
+            return
+        for stmt in body:
+            self._walk_stmt(stmt)
+
+    def _assign_target(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_target(e, tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, tainted)
+        # Attribute / Subscript targets mutate an object; the base keeps
+        # whatever taint it already has.
+
+    # -- structural iteration --------------------------------------------
+    #
+    # ``for i, (op, st) in enumerate(zip(ops, statics))`` iterates PYTREE
+    # STRUCTURE: keys/indices are static, and each zipped source carries
+    # its own taint. Model the common structural iterators so a static
+    # companion (static_argnames pytrees, dict keys) doesn't get smeared
+    # with taint from its traced neighbor.
+
+    def _iter_element_taint(self, it: ast.AST):
+        """Taint spec for one element of ``it``: bool, or a list of specs
+        for a tuple-shaped element (zip/enumerate/items)."""
+        if isinstance(it, ast.Call):
+            fn = it.func
+            if isinstance(fn, ast.Name):
+                if fn.id == "range":
+                    return False
+                if fn.id == "zip":
+                    return [self._iter_element_taint(a) for a in it.args]
+                if fn.id == "enumerate" and it.args:
+                    return [False, self._iter_element_taint(it.args[0])]
+                if fn.id in ("sorted", "reversed", "list", "tuple") and it.args:
+                    return self._iter_element_taint(it.args[0])
+            if isinstance(fn, ast.Attribute):
+                if fn.attr == "items":
+                    t = self.is_tainted(fn.value)
+                    return [False, PLAIN_TAINTED if t else False]
+                if fn.attr == "keys":
+                    return False
+                if fn.attr == "values":
+                    return (
+                        PLAIN_TAINTED if self.is_tainted(fn.value) else False
+                    )
+        return PLAIN_TAINTED if self.is_tainted(it) else False
+
+    def _assign_iter_target(self, target: ast.AST, spec) -> None:
+        if isinstance(spec, list):
+            if isinstance(target, (ast.Tuple, ast.List)) and len(
+                target.elts
+            ) == len(spec):
+                for t, s in zip(target.elts, spec):
+                    self._assign_iter_target(t, s)
+                return
+            spec = _spec_any(spec)
+        self._assign_target(target, bool(spec))
+
+    def _walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested def: runs under the same trace when called; params and
+            # closed-over tracers are tainted inside it.
+            inner = JitScope(node=stmt, why=self.scope.why)
+            sub = TaintWalker(self.ctx, inner, self.on_event)
+            sub.tainted |= self.tainted
+            sub.run()
+            return
+        if isinstance(stmt, (ast.Assign,)):
+            self._check_expr(stmt.value)
+            tainted = self.is_tainted(stmt.value)
+            for t in stmt.targets:
+                self._assign_target(t, tainted)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._check_expr(stmt.value)
+                self._assign_target(stmt.target, self.is_tainted(stmt.value))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._check_expr(stmt.value)
+            if self.is_tainted(stmt.value):
+                self._assign_target(stmt.target, True)
+            return
+        if isinstance(stmt, ast.If):
+            self._check_expr(stmt.test)
+            if self.is_tainted(stmt.test):
+                self.on_event(
+                    HOST_SYNC,
+                    stmt.test,
+                    "`if` on a traced value forces a host sync / trace-time "
+                    "concretization; use jnp.where or lax.cond",
+                )
+            for s in [*stmt.body, *stmt.orelse]:
+                self._walk_stmt(s)
+            return
+        if isinstance(stmt, ast.While):
+            self._check_expr(stmt.test)
+            if self.is_tainted(stmt.test):
+                self.on_event(
+                    HOST_SYNC,
+                    stmt.test,
+                    "`while` on a traced value cannot stay on device; use "
+                    "lax.while_loop",
+                )
+            for s in [*stmt.body, *stmt.orelse]:
+                self._walk_stmt(s)
+            return
+        if isinstance(stmt, ast.For):
+            self._check_expr(stmt.iter)
+            spec = self._iter_element_taint(stmt.iter)
+            if spec is PLAIN_TAINTED:
+                # Iterating a bare traced value: a traced ARRAY unrolls /
+                # concretizes. (Python-container pytrees iterate fine and
+                # are handled structurally above via zip/enumerate/items.)
+                self.on_event(
+                    HOST_SYNC,
+                    stmt.iter,
+                    "iterating a traced value concretizes or unrolls it; "
+                    "use lax.scan or index with a static length",
+                )
+            self._assign_iter_target(stmt.target, spec)
+            for s in [*stmt.body, *stmt.orelse]:
+                self._walk_stmt(s)
+            return
+        if isinstance(stmt, ast.Assert):
+            self._check_expr(stmt.test)
+            if self.is_tainted(stmt.test):
+                self.on_event(
+                    HOST_SYNC,
+                    stmt.test,
+                    "`assert` on a traced value concretizes it; use "
+                    "checkify or a debug callback",
+                )
+            return
+        if isinstance(stmt, (ast.Return,)):
+            if stmt.value is not None:
+                self._check_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._check_expr(stmt.value)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_expr(item.context_expr)
+            for s in stmt.body:
+                self._walk_stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in [
+                *stmt.body,
+                *(h_s for h in stmt.handlers for h_s in h.body),
+                *stmt.orelse,
+                *stmt.finalbody,
+            ]:
+                self._walk_stmt(s)
+            return
+        # Raise / Pass / Import / Global / Nonlocal / Delete: nothing traced.
+
+    # -- expression-level hazard checks ---------------------------------
+
+    def _check_expr(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            self._check_call(node)
+
+    def _check_call(self, node: ast.Call) -> None:
+        args_tainted = any(
+            self.is_tainted(a) for a in node.args
+        ) or any(self.is_tainted(kw.value) for kw in node.keywords)
+        # bool(x) / int(x) / float(x) on a tracer
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in HOST_SYNC_CASTS
+            and node.args
+            and self.is_tainted(node.args[0])
+        ):
+            self.on_event(
+                HOST_SYNC,
+                node,
+                f"`{node.func.id}()` on a traced value forces a host sync "
+                "(concretization error under jit)",
+            )
+            return
+        if isinstance(node.func, ast.Attribute):
+            # x.item() / x.tolist() on a tracer
+            if node.func.attr in HOST_SYNC_METHODS and self.is_tainted(
+                node.func.value
+            ):
+                self.on_event(
+                    HOST_SYNC,
+                    node,
+                    f"`.{node.func.attr}()` on a traced value forces a "
+                    "device->host transfer",
+                )
+                return
+            path = self.ctx.resolve(node.func)
+            if path is not None and (
+                path.startswith("numpy.") or path == "numpy"
+            ):
+                if args_tainted:
+                    if node.func.attr in ("asarray", "array", "copy"):
+                        self.on_event(
+                            HOST_SYNC,
+                            node,
+                            f"`np.{node.func.attr}` on a traced value pulls "
+                            "it to the host; use jnp",
+                        )
+                    else:
+                        self.on_event(
+                            NUMPY_ON_TRACER,
+                            node,
+                            f"`np.{node.func.attr}` called on a traced "
+                            "value executes on host per call; use the jnp "
+                            "equivalent",
+                        )
+
+
+def walk_jit_scopes(
+    ctx: ModuleContext, on_event: Callable[[str, ast.AST, str, JitScope], None]
+) -> None:
+    """Run the taint walk over every jit scope in the module."""
+    for scope in find_jit_scopes(ctx):
+        def fire(kind: str, node: ast.AST, detail: str, _s=scope) -> None:
+            on_event(kind, node, detail, _s)
+
+        TaintWalker(ctx, scope, fire).run()
+
+
+def nearest_loop_before_function(
+    ctx: ModuleContext, node: ast.AST
+) -> ast.AST | None:
+    """The For/While the node sits in, unless a def/lambda intervenes."""
+    for anc in ctx.parent_chain(node):
+        if isinstance(anc, (ast.For, ast.While)):
+            return anc
+        if isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return None
+    return None
+
+
+def iter_calls(ctx: ModuleContext) -> Iterator[ast.Call]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            yield node

@@ -1,0 +1,363 @@
+"""The initial rule set — every rule is a hazard this repo actually hit.
+
+See ANALYSIS.md at the repo root for each rule's rationale with the
+in-repo example that motivated it, the suppression syntax, and the CLI.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from photon_tpu.analysis.core import Finding, ModuleContext, rule
+from photon_tpu.analysis.jitscope import (
+    HOST_SYNC,
+    NUMPY_ON_TRACER,
+    find_jit_scopes,
+    iter_calls,
+    nearest_loop_before_function,
+    walk_jit_scopes,
+)
+
+_JIT_PATHS = frozenset(
+    {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+)
+
+
+def _finding(
+    ctx: ModuleContext, rule_id: str, node: ast.AST, message: str
+) -> Finding:
+    return Finding(
+        rule=rule_id,
+        path=ctx.path,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        message=message,
+    )
+
+
+# --------------------------------------------------------------------------
+# host-sync-in-jit / numpy-on-tracer (one shared taint walk)
+# --------------------------------------------------------------------------
+
+
+def _taint_events(ctx: ModuleContext) -> list[tuple]:
+    """All (kind, node, detail, scope) taint events, walked ONCE per
+    module and memoized on the context — both taint rules filter this."""
+    cached = getattr(ctx, "_taint_events_cache", None)
+    if cached is None:
+        cached = []
+
+        def on_event(kind, node, detail, scope):
+            cached.append((kind, node, detail, scope))
+
+        walk_jit_scopes(ctx, on_event)
+        ctx._taint_events_cache = cached
+    return cached
+
+
+def _taint_findings(ctx: ModuleContext, want_kind: str, rule_id: str):
+    out: list[Finding] = []
+    for kind, node, detail, scope in _taint_events(ctx):
+        if kind != want_kind:
+            continue
+        out.append(
+            _finding(
+                ctx,
+                rule_id,
+                node,
+                f"{detail} (function `{_scope_name(scope.node)}` "
+                f"{scope.why})",
+            )
+        )
+    return out
+
+
+def _scope_name(node: ast.AST) -> str:
+    return getattr(node, "name", "<lambda>")
+
+
+@rule(
+    "host-sync-in-jit",
+    "implicit bool()/int()/float()/if/.item()/np.asarray on a traced value "
+    "inside a jit/scan/while_loop body",
+)
+def host_sync_in_jit(ctx: ModuleContext) -> Iterator[Finding]:
+    yield from _taint_findings(ctx, HOST_SYNC, "host-sync-in-jit")
+
+
+@rule(
+    "numpy-on-tracer",
+    "np.* called on a traced value where jnp is required",
+)
+def numpy_on_tracer(ctx: ModuleContext) -> Iterator[Finding]:
+    yield from _taint_findings(ctx, NUMPY_ON_TRACER, "numpy-on-tracer")
+
+
+# --------------------------------------------------------------------------
+# recompile-hazard
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "recompile-hazard",
+    "jit construction per call / unhashable static argument — every hit "
+    "recompiles instead of reusing the cache",
+)
+def recompile_hazard(ctx: ModuleContext) -> Iterator[Finding]:
+    # Map: name of a jit-wrapped function -> its static_argnames, so call
+    # sites can be checked for unhashable static values.
+    static_names_by_func: dict[str, frozenset[str]] = {}
+    for scope in find_jit_scopes(ctx):
+        name = getattr(scope.node, "name", None)
+        if name and scope.static_argnames:
+            static_names_by_func[name] = scope.static_argnames
+
+    for call in iter_calls(ctx):
+        path = ctx.resolve(call.func)
+        if path in _JIT_PATHS:
+            loop = nearest_loop_before_function(ctx, call)
+            if loop is not None:
+                yield _finding(
+                    ctx,
+                    "recompile-hazard",
+                    call,
+                    "jax.jit(...) constructed inside a loop: every "
+                    "iteration builds a fresh wrapper and retraces; hoist "
+                    "the jitted callable out of the loop",
+                )
+                continue
+            parent = ctx.parents.get(call)
+            if isinstance(parent, ast.Call) and parent.func is call:
+                yield _finding(
+                    ctx,
+                    "recompile-hazard",
+                    call,
+                    "jax.jit(f)(...) constructs and immediately calls a "
+                    "fresh wrapper: the compile cache is keyed on the "
+                    "wrapper, so each call site pays a retrace; bind "
+                    "jax.jit(f) once and reuse it",
+                )
+                continue
+        # call sites of known-static functions: unhashable static values
+        if isinstance(call.func, ast.Name):
+            statics = static_names_by_func.get(call.func.id)
+            if statics:
+                for kw in call.keywords:
+                    if kw.arg in statics and isinstance(
+                        kw.value,
+                        (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp),
+                    ):
+                        yield _finding(
+                            ctx,
+                            "recompile-hazard",
+                            kw.value,
+                            f"unhashable value for static argument "
+                            f"`{kw.arg}`: jit static args key the compile "
+                            "cache and must be hashable (tuple, frozen "
+                            "dataclass); a list/dict/set raises or, worse, "
+                            "defeats caching",
+                        )
+
+
+# --------------------------------------------------------------------------
+# float64-literal
+# --------------------------------------------------------------------------
+
+_F64_PATHS = frozenset({"numpy.float64", "jax.numpy.float64"})
+
+
+def _is_f64(ctx: ModuleContext, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "float64":
+        return True
+    return ctx.resolve(node) in _F64_PATHS
+
+
+@rule(
+    "float64-literal",
+    "float64 dtype inside traced code or as a signature default — silently "
+    "becomes float32 under default x64-disabled JAX, or doubles slab "
+    "memory when x64 is on",
+)
+def float64_literal(ctx: ModuleContext) -> Iterator[Finding]:
+    # (a) anywhere inside a jit scope
+    seen: set[ast.AST] = set()
+    for scope in find_jit_scopes(ctx):
+        for node in ast.walk(scope.node):
+            if node in seen:
+                continue
+            if _is_f64(ctx, node):
+                seen.add(node)
+                yield _finding(
+                    ctx,
+                    "float64-literal",
+                    node,
+                    "float64 inside a traced function: under the default "
+                    "x64-disabled config this silently produces float32; "
+                    "spell the intended dtype explicitly",
+                )
+    # (b) as a parameter default anywhere (the classic dtype=np.float64)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = [*node.args.defaults, *node.args.kw_defaults]
+        for d in defaults:
+            if d is not None and d not in seen and _is_f64(ctx, d):
+                seen.add(d)
+                yield _finding(
+                    ctx,
+                    "float64-literal",
+                    d,
+                    f"float64 default in `{node.name}` signature: callers "
+                    "inherit a dtype the float32 pipeline will down-cast "
+                    "(or double memory under x64); default to the "
+                    "pipeline dtype",
+                )
+
+
+# --------------------------------------------------------------------------
+# int32-overflow
+# --------------------------------------------------------------------------
+
+_I32_PATHS = frozenset({"numpy.int32", "jax.numpy.int32"})
+_GUARD_LIMIT = 2**31
+
+
+def _is_i32_dtype(ctx: ModuleContext, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "int32":
+        return True
+    return ctx.resolve(node) in _I32_PATHS
+
+
+def _has_arith(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.BinOp) and isinstance(
+            sub.op, (ast.Add, ast.Mult, ast.Sub)
+        ):
+            return True
+    return False
+
+
+def _int_guard_present(ctx: ModuleContext, node: ast.AST) -> bool:
+    """2**31 / 1<<31 / iinfo(int32) mentioned in the enclosing function."""
+    func = ctx.enclosing_function(node) or ctx.tree
+    for sub in ast.walk(func):
+        if isinstance(sub, ast.Constant) and sub.value in (
+            _GUARD_LIMIT,
+            _GUARD_LIMIT - 1,
+        ):
+            return True
+        if isinstance(sub, ast.BinOp):
+            if (
+                isinstance(sub.op, (ast.Pow, ast.LShift))
+                and isinstance(sub.left, ast.Constant)
+                and sub.left.value == 2
+                and isinstance(sub.right, ast.Constant)
+                and sub.right.value == 31
+            ):
+                return True
+        if isinstance(sub, ast.Call):
+            path = ctx.resolve(sub.func)
+            if path in ("numpy.iinfo", "jax.numpy.iinfo"):
+                return True
+    return False
+
+
+@rule(
+    "int32-overflow",
+    "int32 cast of computed index arithmetic with no 2**31 guard in scope "
+    "— flat indices silently wrap at scale",
+)
+def int32_overflow(ctx: ModuleContext) -> Iterator[Finding]:
+    for call in iter_calls(ctx):
+        operand: ast.AST | None = None
+        # X.astype(np.int32 / "int32")
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "astype"
+            and call.args
+            and _is_i32_dtype(ctx, call.args[0])
+        ):
+            operand = call.func.value
+        # np.int32(X)
+        elif ctx.resolve(call.func) in _I32_PATHS and call.args:
+            operand = call.args[0]
+        # np.asarray(X, dtype=np.int32)
+        elif ctx.resolve(call.func) in (
+            "numpy.asarray",
+            "numpy.array",
+        ) and call.args:
+            for kw in call.keywords:
+                if kw.arg == "dtype" and _is_i32_dtype(ctx, kw.value):
+                    operand = call.args[0]
+        if operand is None or not _has_arith(operand):
+            continue
+        if _int_guard_present(ctx, call):
+            continue
+        yield _finding(
+            ctx,
+            "int32-overflow",
+            call,
+            "int32 cast of index arithmetic with no 2**31 guard in the "
+            "enclosing function: past 2^31 elements the indices silently "
+            "wrap (data/random_effect.py's inverse score map was the "
+            "in-repo case); assert the bound or promote to int64",
+        )
+
+
+# --------------------------------------------------------------------------
+# debug-debris
+# --------------------------------------------------------------------------
+
+_DEBRIS_CALLS = {
+    "jax.debug.print": "jax.debug.print adds a host callback per trace — "
+    "debugging leftovers serialize the device stream",
+    "jax.debug.breakpoint": "jax.debug.breakpoint halts every execution",
+    "pdb.set_trace": "pdb.set_trace() left in library code",
+}
+
+
+@rule(
+    "debug-debris",
+    "jax.debug.print / pdb / breakpoint() / block_until_ready in a hot "
+    "loop — debugging leftovers that serialize or halt production runs",
+)
+def debug_debris(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = (
+                [a.name for a in node.names]
+                if isinstance(node, ast.Import)
+                else [node.module or ""]
+            )
+            if any(n == "pdb" or n.startswith("pdb.") for n in names):
+                yield _finding(
+                    ctx, "debug-debris", node, "`import pdb` in library code"
+                )
+    for call in iter_calls(ctx):
+        if isinstance(call.func, ast.Name) and call.func.id == "breakpoint":
+            yield _finding(
+                ctx, "debug-debris", call, "`breakpoint()` in library code"
+            )
+            continue
+        path = ctx.resolve(call.func)
+        if path in _DEBRIS_CALLS:
+            yield _finding(ctx, "debug-debris", call, _DEBRIS_CALLS[path])
+            continue
+        is_bur = (
+            path == "jax.block_until_ready"
+            or (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "block_until_ready"
+            )
+        )
+        if is_bur and nearest_loop_before_function(ctx, call) is not None:
+            yield _finding(
+                ctx,
+                "debug-debris",
+                call,
+                "block_until_ready inside a loop serializes the async "
+                "dispatch pipeline per iteration; sync once after the "
+                "loop (or not at all — the first consumer blocks)",
+            )

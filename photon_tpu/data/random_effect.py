@@ -1467,6 +1467,16 @@ def build_random_effect_dataset(
             ).astype(np.int32)
             base += bh["brow"].size
         passive_rows = np.nonzero(~covered_np)[0]
+        # base counts PADDED bucket blocks (B*cap per bucket, larger than
+        # the row count), so it can cross 2^31 well before n does; past
+        # that the int32 map silently wraps and corrupts scoring.
+        if base + passive_rows.size >= 2**31:
+            raise OverflowError(
+                "flat score layout has "
+                f"{base + passive_rows.size} elements, which overflows the "
+                "int32 inverse score map; shard the random effect wider "
+                "(smaller buckets) or reduce score_table_width_cap"
+            )
         score_inv_np[passive_rows] = base + np.arange(
             passive_rows.size, dtype=np.int32)
 

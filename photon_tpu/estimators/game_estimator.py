@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+from collections import OrderedDict
 from typing import Union
 
 import jax
@@ -61,6 +62,13 @@ from photon_tpu.types import TaskType
 
 Array = jax.Array
 logger = logging.getLogger(__name__)
+
+# Distinct fused whole-fit programs retained per estimator. Each entry
+# pins one compiled fit executable; the dataset-scale device buffers (the
+# materialized bucket slabs) are shared across entries through the
+# generation's _fused_mat_share, so the bound limits executables, not
+# slab HBM. A handful covers realistic mixed-optimizer config grids.
+_FUSED_CACHE_SIZE = 8
 
 # Default primary evaluator per task (GameEstimator.scala:673
 # prepareValidationEvaluators falls back to the task's default evaluator).
@@ -506,10 +514,13 @@ class GameEstimator:
         None when ineligible (mesh execution, listeners, down-sampling,
         materialized datasets — see fused_fit.fuse_eligible).
 
-        Cached per (dataset generation, static structure): a lambda-grid
-        config sequence re-enters the SAME compiled executable with new
-        traced weights (the warm-start ladder of GameEstimator.scala
-        :452-468 with zero recompiles)."""
+        Cached per (dataset generation, static structure) in a small LRU
+        keyed by the static key: a lambda-grid config sequence re-enters
+        the SAME compiled executable with new traced weights (the
+        warm-start ladder of GameEstimator.scala:452-468 with zero
+        recompiles), and a grid that ALTERNATES static keys (e.g. mixed
+        optimizer configs) round-robins among cached programs instead of
+        rebuilding the whole-fit trace on every entry."""
         if self.resolve_mesh() is not None or self.emitter is not None:
             return None
         from photon_tpu.algorithm.fused_fit import (
@@ -524,14 +535,28 @@ class GameEstimator:
             coords, self.update_sequence, self.num_iterations,
             self.locked_coordinates,
         )
-        cached = getattr(self, "_fused_cache", None)
-        if cached is not None and cached[0] == key and cached[1] is datasets:
-            return cached[2]
+        cache = getattr(self, "_fused_cache", None)
+        share = getattr(self, "_fused_mat_share", None)
+        if cache is None or share is None or share["datasets"] is not datasets:
+            # New dataset generation (or first use): every cached program
+            # and the materialized-slab set are stale together. The share
+            # carries its generation's datasets identity so the check is
+            # symmetric for hits and misses.
+            cache = self._fused_cache = OrderedDict()
+            share = self._fused_mat_share = {"datasets": datasets}
+        fused = cache.get(key)
+        if fused is not None:
+            cache.move_to_end(key)
+            return fused
         fused = FusedFit(
             coords, self.update_sequence, self.num_iterations,
             self.locked_coordinates,
+            mat_share=share,
         )
-        self._fused_cache = (key, datasets, fused)
+        fused.static_key = key
+        cache[key] = fused
+        while len(cache) > _FUSED_CACHE_SIZE:
+            cache.popitem(last=False)
         return fused
 
     def _build_validation(
@@ -607,6 +632,7 @@ class GameEstimator:
         # (2x peak HBM).
         self._primed_datasets = None
         self._fused_cache = None
+        self._fused_mat_share = None
         self._fit_cache = None
         datasets = self._build_datasets(data, initial_model)
         val_ctx = (

@@ -138,7 +138,10 @@ def make_suite(
     offsets=None,
     weights=None,
     group_ids: dict[str, tuple[Array, int]] | None = None,
-    dtype=jnp.float64,
+    # Deliberate: under default x64-disabled JAX this resolves to float32
+    # (matching the training pipeline); when a debugging run enables x64,
+    # evaluation accumulations get full precision for free.
+    dtype=jnp.float64,  # photon: ignore[float64-literal] -- intended x64 opt-in; f32 under default config
 ) -> EvaluationSuite:
     labels = jnp.asarray(labels, dtype=dtype)
     n = labels.shape[0]

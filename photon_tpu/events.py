@@ -43,7 +43,9 @@ class CoordinateUpdateEvent(PhotonEvent):
         return self.record.coordinate_id
 
     @property
-    def seconds(self) -> float:
+    def seconds(self) -> float | None:
+        # None on the fused whole-fit path (one device program: no
+        # per-coordinate dispatch time exists; see CoordinateUpdateRecord).
         return self.record.seconds
 
     @property

@@ -54,6 +54,16 @@ _MAX_RS = 16_384
 _LINE_SEARCH_TRIALS = 16
 
 
+def interpret_required() -> bool:
+    """True when pallas_call must run interpreted on this backend.
+
+    Mosaic lowering is TPU-only: a force-flagged run on any other
+    backend (CPU, GPU) routes through ``interpret=True`` (slow, but
+    correct and traceable) instead of crashing in lowering.
+    """
+    return jax.default_backend() != "tpu"
+
+
 def kernel_supported(task: TaskType, dtype, r: int, s: int) -> bool:
     flag = os.environ.get("PHOTON_NEWTON_KERNEL", "auto").lower()
     if flag in ("0", "off", "false"):
@@ -66,8 +76,14 @@ def kernel_supported(task: TaskType, dtype, r: int, s: int) -> bool:
     if r * s > _MAX_RS:
         return False
     if flag in ("1", "on", "force"):
+        # Callers pass interpret=interpret_required() so a forced run on
+        # a non-TPU backend executes the interpreter path rather than
+        # failing in Mosaic.
         return True
-    return jax.default_backend() not in ("cpu",)
+    # Auto: only a real TPU runs the kernel. Other accelerators must take
+    # the batch-minor XLA fallback — the interpreter path is orders of
+    # magnitude slower and is reserved for the explicit force flag.
+    return jax.default_backend() == "tpu"
 
 
 def _loss_terms(task: TaskType, z, y):

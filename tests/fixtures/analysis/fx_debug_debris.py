@@ -1,0 +1,45 @@
+"""Fixture: debug-debris — positive, suppressed, and clean variants."""
+import pdb  # EXPECT: debug-debris
+
+import jax
+
+
+def positive_debug_print(x):
+    jax.debug.print("x = {}", x)  # EXPECT: debug-debris
+    return x
+
+
+def positive_breakpoint(x):
+    breakpoint()  # EXPECT: debug-debris
+    return x
+
+
+def positive_set_trace(x):
+    pdb.set_trace()  # EXPECT: debug-debris
+    return x
+
+
+def positive_block_in_loop(xs):
+    for x in xs:
+        jax.block_until_ready(x)  # EXPECT: debug-debris
+    return xs
+
+
+def suppressed_block_in_loop(xs):
+    for x in xs:
+        jax.block_until_ready(x)  # photon: ignore[debug-debris] -- fixture: CPU-mesh serialization
+    return xs
+
+
+def clean_block_once(xs):
+    ys = [x * 2 for x in xs]
+    jax.block_until_ready(ys)
+    return ys
+
+
+def clean_thunk_in_loop(xs):
+    # The call sits inside a lambda: it does not execute per iteration.
+    thunks = []
+    for x in xs:
+        thunks.append(lambda x=x: jax.block_until_ready(x))
+    return thunks

@@ -1,0 +1,34 @@
+"""Fixture: float64-literal — positive, suppressed, and clean variants."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def positive_cast_in_jit(x):
+    return x.astype(np.float64)  # EXPECT: float64-literal
+
+
+@jax.jit
+def positive_string_dtype(x):
+    return jnp.asarray(x, dtype="float64")  # EXPECT: float64-literal
+
+
+def positive_signature_default(x, dtype=np.float64):  # EXPECT: float64-literal
+    return np.asarray(x, dtype=dtype)
+
+
+@jax.jit
+def suppressed_in_jit(x):
+    return x.astype(jnp.float64)  # photon: ignore[float64-literal] -- fixture: x64-only code path
+
+
+def clean_host_side_stats(xs):
+    # Host-side float64 accumulation (feature stats, ingest) is deliberate
+    # and outside any trace: not flagged.
+    return np.asarray(xs, dtype=np.float64).mean()
+
+
+@jax.jit
+def clean_pipeline_dtype(x):
+    return x.astype(jnp.float32)

@@ -1,0 +1,75 @@
+"""Fixture: host-sync-in-jit — positive, suppressed, and clean variants.
+
+Never imported; parsed by the analyzer only. An EXPECT comment marks a
+line that must produce exactly the named unsuppressed findings.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def positive_if_on_tracer(x):
+    if x > 0:  # EXPECT: host-sync-in-jit
+        return x
+    return -x
+
+
+@jax.jit
+def positive_casts(x):
+    a = float(x.sum())  # EXPECT: host-sync-in-jit
+    b = x.max().item()  # EXPECT: host-sync-in-jit
+    return a + b
+
+
+@jax.jit
+def positive_asarray(x):
+    y = np.asarray(x)  # EXPECT: host-sync-in-jit
+    return jnp.sum(y)
+
+
+def positive_while_loop_body(x):
+    def cond(v):
+        return bool(v[1])  # EXPECT: host-sync-in-jit
+
+    def body(v):
+        if v[0] > 0:  # EXPECT: host-sync-in-jit
+            return -v
+        return v
+
+    return lax.while_loop(cond, body, x)
+
+
+@jax.jit
+def suppressed_sync(x):
+    flag = bool(x[0])  # photon: ignore[host-sync-in-jit] -- fixture: deliberate sync
+    return x * flag
+
+
+@jax.jit
+def clean_static_metadata(x, n):
+    # shape/ndim/dtype reads and range() over them stay host-static.
+    acc = jnp.zeros((), x.dtype)
+    for i in range(x.shape[0]):
+        acc = acc + x[i]
+    if x.ndim > 1:
+        acc = acc / n
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def clean_static_argname(x, mode):
+    if mode == "double":
+        return x * 2.0
+    return x
+
+
+@jax.jit
+def clean_structure_checks(x, extras):
+    # `is None` and dict-membership are pytree structure, not values.
+    if extras is not None and "offset" in extras:
+        x = x + extras["offset"]
+    return x

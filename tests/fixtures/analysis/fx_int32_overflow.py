@@ -1,0 +1,29 @@
+"""Fixture: int32-overflow — positive, suppressed, and clean variants."""
+import numpy as np
+
+
+def positive_flat_index(base, t_of, cap, r_of):
+    return (base + t_of * cap + r_of).astype(np.int32)  # EXPECT: int32-overflow
+
+
+def positive_np_int32(b, cap):
+    return np.int32(b * cap)  # EXPECT: int32-overflow
+
+
+def positive_asarray_dtype(rows, stride):
+    return np.asarray(rows * stride, dtype=np.int32)  # EXPECT: int32-overflow
+
+
+def suppressed_cast(b, cap):
+    return np.int32(b * cap)  # photon: ignore[int32-overflow] -- fixture: bounded by ingest validator
+
+
+def clean_guarded(base, t_of, cap, r_of):
+    if base + cap >= 2**31:
+        raise OverflowError("flat score layout overflows int32")
+    return (base + t_of * cap + r_of).astype(np.int32)
+
+
+def clean_plain_cast(codes):
+    # No index arithmetic under the cast: not flagged.
+    return codes.astype(np.int32)

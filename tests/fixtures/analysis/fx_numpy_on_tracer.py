@@ -1,0 +1,33 @@
+"""Fixture: numpy-on-tracer — positive, suppressed, and clean variants."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def positive_np_reduce(x):
+    return np.sum(x)  # EXPECT: numpy-on-tracer
+
+
+def positive_scan_body(xs):
+    def step(carry, x):
+        y = np.maximum(carry, x)  # EXPECT: numpy-on-tracer
+        return y, y
+
+    return lax.scan(step, xs[0], xs)
+
+
+@jax.jit
+def suppressed_np(x):
+    return np.clip(x, 0, 1)  # photon: ignore[numpy-on-tracer] -- fixture: fails loudly in CI
+
+@jax.jit
+def clean_np_on_static(x):
+    # numpy on host-static metadata is fine inside jit.
+    pad = np.zeros(x.shape[0], dtype=np.float32)
+    return x + jnp.asarray(pad)
+
+
+def clean_np_outside_jit(xs):
+    return np.concatenate([np.asarray(x) for x in xs])

@@ -1,0 +1,48 @@
+"""Fixture: recompile-hazard — positive, suppressed, and clean variants."""
+import functools
+
+import jax
+
+
+def _inner(x):
+    return x * 2.0
+
+
+def positive_jit_in_loop(fns, x):
+    outs = []
+    for f in fns:
+        jf = jax.jit(f)  # EXPECT: recompile-hazard
+        outs.append(jf(x))
+    return outs
+
+
+def positive_construct_and_call(x):
+    return jax.jit(_inner)(x)  # EXPECT: recompile-hazard
+
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def _kernel(x, opts):
+    return x * opts[0]
+
+
+def positive_unhashable_static(x):
+    return _kernel(x, opts=[1, 2])  # EXPECT: recompile-hazard
+
+
+def suppressed_jit_in_loop(fns, x):
+    for f in fns:
+        x = jax.jit(f)(x)  # photon: ignore[recompile-hazard] -- fixture: one-shot tools script
+    return x
+
+
+_clean_module_level = jax.jit(_inner)
+
+
+def clean_hashable_static(x):
+    return _kernel(x, opts=(1, 2))
+
+
+def clean_cached_construction(self_like):
+    # One-time construction outside any loop (e.g. in __init__) is fine.
+    jitted = jax.jit(_inner)
+    return jitted

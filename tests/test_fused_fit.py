@@ -298,3 +298,55 @@ class TestFusedFallbacks:
         coord = RandomEffectCoordinate(
             ds, TaskType.LINEAR_REGRESSION, _l2(0.5))
         assert not fuse_eligible({"per-user": coord})
+
+
+class TestFusedHistoryAndCache:
+    def test_fused_history_seconds_is_none(self, rng):
+        """Per-update seconds on the fused path are None (one device
+        program: no per-coordinate time exists), never a synthetic
+        uniform split. The unfused path keeps measured dispatch floats
+        (tests/test_events.py)."""
+        game = _game(rng, "linear")
+        est = _estimator("linear", mesh=None)
+        r = est.fit(game)[0]
+        assert est._fused_cache, "fused path did not run"
+        assert len(r.descent.history) > 0
+        assert all(rec.seconds is None for rec in r.descent.history)
+
+    def test_alternating_static_keys_reuse_cached_programs(
+        self, rng, monkeypatch
+    ):
+        """A config grid alternating static keys (L2 <-> L1 routing) must
+        build each fused program ONCE and round-robin among cached
+        entries — not rebuild per grid entry (the single-slot cache
+        regression)."""
+        import photon_tpu.algorithm.fused_fit as ff
+
+        builds = []
+        real_fused_fit = ff.FusedFit
+
+        class CountingFusedFit(real_fused_fit):
+            def __init__(self, *args, **kwargs):
+                builds.append(1)
+                super().__init__(*args, **kwargs)
+
+        monkeypatch.setattr(ff, "FusedFit", CountingFusedFit)
+        game = _game(rng, "linear")
+        est = _estimator("linear", mesh=None)
+        l1 = GLMOptimizationConfiguration(
+            regularization=optim.RegularizationContext(
+                optim.RegularizationType.L1),
+            regularization_weight=0.01,
+        )
+        seq = [{"global": _l2(0.01)}, {"global": l1}] * 2
+        results = est.fit(game, opt_config_sequence=seq)
+        assert len(results) == 4
+        assert all(r.model is not None for r in results)
+        assert len(builds) == 2, "each static key must compile exactly once"
+        assert len(est._fused_cache) == 2
+        # The dataset-scale materialized slabs are SHARED across cached
+        # programs (one set per generation), not pinned once per entry.
+        entries = list(est._fused_cache.values())
+        assert all(f._mat_shared is est._fused_mat_share for f in entries)
+        assert "ebs" in est._fused_mat_share
+        assert all(f._mat_cache is None for f in entries)

@@ -161,3 +161,50 @@ def test_kernel_supported_gates(rng):
         TaskType.LINEAR_REGRESSION, jnp.float32, 64, 17)
     assert not nk.kernel_supported(
         TaskType.LOGISTIC_REGRESSION, jnp.float32, 4096, 17)
+
+
+def test_force_flag_on_cpu_selects_kernel_with_interpret(monkeypatch, rng):
+    """A force-flagged CPU run must route through interpret=True rather
+    than crashing in Mosaic lowering (TPU-only). kernel_supported says
+    yes, interpret_required says 'interpreter', and the forced step
+    actually executes and matches the XLA reference."""
+    monkeypatch.setenv("PHOTON_NEWTON_KERNEL", "force")
+    assert nk.kernel_supported(
+        TaskType.LOGISTIC_REGRESSION, jnp.float32, 64, 17)
+    assert nk.interpret_required()  # CPU backend in the test env
+
+    b, r, s = 8, 16, 3
+    x = rng.normal(size=(b, r, s)).astype(np.float32)
+    w = np.zeros((b, s), np.float32)
+    y = (rng.uniform(size=(b, r)) > 0.5).astype(np.float32)
+    wt = np.ones((b, r), np.float32)
+    off = np.zeros((b, r), np.float32)
+    l2 = np.full((b, s), 0.5, np.float32)
+    mt = np.zeros((b, s), np.float32)
+    vm = np.ones((b, s), np.float32)
+
+    from photon_tpu.ops import losses as losses_mod
+
+    loss = losses_mod.get_loss(TaskType.LOGISTIC_REGRESSION)
+    z0 = jnp.einsum("brs,bs->br", x, w) + off
+    f0 = jnp.sum(wt * loss.loss(z0, y), axis=-1) + 0.5 * jnp.sum(
+        l2 * (w - mt) ** 2, axis=-1)
+
+    bp = nk.pad_lanes(b)
+    pad = lambda a: np.pad(a, [(0, bp - b)] + [(0, 0)] * (a.ndim - 1))
+    x_l = jnp.asarray(np.transpose(pad(x), (2, 1, 0)))
+    to_l = lambda a: jnp.asarray(np.transpose(pad(a)))
+    w_k, f_k, g_k, imp_k = nk.newton_step_lanes(
+        x_l, to_l(w), to_l(y), to_l(wt), to_l(off), to_l(l2), to_l(mt),
+        to_l(vm), jnp.asarray(np.pad(np.asarray(f0), (0, bp - b)))[None, :],
+        r=r, s=s, task=TaskType.LOGISTIC_REGRESSION, trials=TRIALS,
+        interpret=nk.interpret_required(),
+    )
+    ref = _reference_step(
+        TaskType.LOGISTIC_REGRESSION,
+        *(jnp.asarray(a) for a in (x, w, y, wt, off, l2, mt, vm)),
+        jnp.asarray(f0),
+    )
+    np.testing.assert_allclose(
+        np.transpose(np.asarray(w_k))[:b], np.asarray(ref[0]),
+        rtol=2e-3, atol=2e-4)
