@@ -81,8 +81,13 @@ import numpy as np
 # throughput on a comparable GLMix workload; the reference repo itself
 # publishes no benchmark numbers.
 ANCHOR_ROWS_PER_SEC = 50_000.0
-PEAK_BF16_FLOPS = 197e12  # TPU v5e per-chip bf16 peak
-PEAK_HBM_BYTES = 819e9  # TPU v5e per-chip HBM bandwidth
+# TPU v5e per-chip peaks — ONE source of truth with the static cost
+# model's roofline (analysis/costmodel.py), so measured utilization and
+# predicted bounds can never drift onto different chips.
+from photon_tpu.analysis.costmodel import CHIP_PEAKS, DEFAULT_CHIP  # noqa: E402
+
+PEAK_BF16_FLOPS = CHIP_PEAKS[DEFAULT_CHIP]["flops_per_sec"]
+PEAK_HBM_BYTES = CHIP_PEAKS[DEFAULT_CHIP]["hbm_bytes_per_sec"]
 
 # MovieLens-shaped scale, round-4 sizing: the round-3 workload's steady
 # state collapsed to single-digit milliseconds once the per-entity solves
@@ -373,6 +378,36 @@ def estimate_hbm_bytes(result, datasets, task_name) -> float:
     return bytes_
 
 
+def predict_program_costs(est, datasets, per_fit_seconds, rows) -> dict:
+    """Static per-program cost predictions for the fit just measured.
+
+    Lowers (never executes) the fused whole-fit + slab-materialization
+    programs through the analysis cost model (analysis/costmodel.py:
+    XLA's HLO cost analysis + a v5e roofline), so the output carries
+    predicted FLOPs/HBM-bytes per program next to the measured
+    throughput. ``measured_vs_roofline`` is measured fit wall-clock over
+    the roofline lower bound — how far the real dispatch sits from the
+    chip's best case. Never fails the bench: an ineligible path (mesh)
+    or a backend without cost analysis reports the reason instead.
+    """
+    try:
+        from photon_tpu.analysis import costmodel
+
+        cache = getattr(est, "_fused_cache", None)
+        if not cache:
+            return {"skipped": "no fused program (unfused/mesh path)"}
+        fused = next(reversed(cache.values()))
+        coords = est._build_coordinates(datasets, {}, {}, rows)
+        report = costmodel.fused_fit_report(fused, coords)
+        pred = report["fused_fit"]["roofline"]["min_seconds"]
+        if pred:
+            report["measured_vs_roofline"] = round(
+                per_fit_seconds / pred, 2)
+        return report
+    except Exception as exc:  # the bench must keep printing its line
+        return {"error": repr(exc)}
+
+
 def _fit_blocking(est, data):
     """One full fit, completion forced via on-device checksums.
 
@@ -458,7 +493,10 @@ def run_variant(task_name):
 
     flops = estimate_model_flops(result, datasets, task_name)
     hbm = estimate_hbm_bytes(result, datasets, task_name)
+    cost_model = predict_program_costs(
+        est, datasets, per_fit, data.num_samples)
     return dict(
+        cost_model=cost_model,
         ingest_seconds=ingest_seconds,
         compile_seconds=compile_seconds,
         train_seconds=per_fit,
@@ -775,11 +813,24 @@ def main():
             f"{name}_hbm_bytes_per_sec": round(v["hbm_bytes_per_sec"], 1),
             f"{name}_fraction_of_hbm_peak": round(
                 v["hbm_bytes_per_sec"] / PEAK_HBM_BYTES, 6),
+            # Static cost model (analysis/costmodel.py): per-program
+            # predicted FLOPs/HBM-bytes + roofline bound for the fused
+            # fit and slab materialization programs.
+            f"{name}_cost_model": v["cost_model"],
         })
     out.update(sklearn_anchor)
     out.update(yahoo)
     out.update(a9a)
     out.update(wide)
+    # Persistent compile-cache effectiveness for THIS process: hit/miss
+    # counts + disk footprint (utils/compile_cache.cache_stats). The
+    # first instrumentation aimed at the BENCH_r05 anomaly where
+    # linear_warm_cache_e2e (14.1s) exceeded cold (11.0s) — a warm rerun
+    # with a zero hit-rate means the cache never served, and that is now
+    # visible in the output instead of inferred.
+    from photon_tpu.utils import cache_stats
+
+    out["compile_cache"] = cache_stats()
     print(json.dumps(out))
 
 

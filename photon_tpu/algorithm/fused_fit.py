@@ -65,6 +65,22 @@ from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 
 Array = jax.Array
 
+# Program contract (audited by `python -m photon_tpu.analysis --semantic`,
+# machinery in analysis/program.py): one fused-fit generation is at most
+# THREE distinct compiled programs — the slab materialization, the cold
+# whole-fit program, and its warm-start twin (has_init is static). A λ-grid
+# config sweep must re-enter those executables; an optimizer swap or an
+# iteration-count change is a declared recompile (new statics by design).
+PROGRAM_AUDIT = dict(
+    name="fused-fit",
+    entry="algorithm.fused_fit.FusedFit (_mat_fn + _fit_fn)",
+    builder="build_fused_fit",
+    max_programs=3,
+    stable_under=("lambda_grid",),
+    recompiles_on=("optimizer_swap", "iteration_count"),
+    hot_loop=True,
+)
+
 
 class _PackedDiags:
     """All per-update diagnostic arrays of one fused fit, packed into ONE
@@ -113,29 +129,74 @@ class FusedFixedEffectStats:
         return int(self._packed.get(self._rs_index)[self._iteration])
 
 
-def fuse_eligible(coords: dict[str, object]) -> bool:
-    """True when every coordinate can ride the single-program fit."""
-    for coord in coords.values():
+def fuse_ineligibility_reasons(
+    coords: dict[str, object],
+    *,
+    mesh=None,
+    emitter=None,
+) -> list[str]:
+    """Every reason this coordinate structure cannot ride the fused fit.
+
+    Empty list == eligible. ``fuse_eligible`` is this predicate on the
+    per-coordinate reasons alone; the estimator's program cache
+    (``GameEstimator._fused_for``) passes its mesh/listener state in too,
+    and the semantic auditor's sharding report uses the same call to
+    state *why* the mesh path is unfused today, not just that it is
+    (analysis/program.py build_mesh_sharding).
+    """
+    reasons: list[str] = []
+    if mesh is not None:
+        reasons.append(
+            "mesh execution: fusing would fold every coordinate's "
+            "collectives into one program with no host serialization "
+            "point between them — the unfused path serializes "
+            "collective-bearing dispatches on CPU meshes "
+            "(coordinate_descent._serialize_on_cpu_mesh) and keeps "
+            "per-bucket programs independently shardable")
+    if emitter is not None:
+        reasons.append(
+            "listeners: per-update events need a host boundary after "
+            "each coordinate update; the fused program has none until "
+            "the whole fit completes")
+    for cid, coord in coords.items():
         if isinstance(coord, ModelCoordinate):
             continue
         inner = getattr(coord, "inner", coord)
         if isinstance(inner, FixedEffectCoordinate):
             rate = inner.config.down_sampling_rate
             if 0.0 < rate < 1.0:
-                return False
+                reasons.append(
+                    f"coordinate {cid!r}: down-sampling reseeds per "
+                    "iteration on host")
             if inner.config.optimizer.box_constraints is not None:
-                return False  # untraced path (trace constants)
+                reasons.append(
+                    f"coordinate {cid!r}: box constraints run the "
+                    "untraced solver path (constraint arrays would bake "
+                    "in as trace constants)")
             if (inner.logical_rows is not None
                     and inner.batch.num_samples != inner.logical_rows):
-                return False  # padded mesh batches stay unfused
+                reasons.append(
+                    f"coordinate {cid!r}: padded mesh batch "
+                    "(num_samples != logical_rows) stays unfused")
             if getattr(inner.batch.features, "logical_d", None) is not None:
-                return False  # column-sharded solve: mesh path
+                reasons.append(
+                    f"coordinate {cid!r}: column-sharded features solve "
+                    "on the mesh path")
         elif isinstance(inner, RandomEffectCoordinate):
             if not inner.dataset.is_lazy:
-                return False  # materialized score tables: legacy path
+                reasons.append(
+                    f"coordinate {cid!r}: materialized score tables ride "
+                    "the legacy scoring path")
         else:
-            return False
-    return True
+            reasons.append(
+                f"coordinate {cid!r}: unknown coordinate type "
+                f"{type(inner).__name__}")
+    return reasons
+
+
+def fuse_eligible(coords: dict[str, object]) -> bool:
+    """True when every coordinate can ride the single-program fit."""
+    return not fuse_ineligibility_reasons(coords)
 
 
 def _re_statics(coord: RandomEffectCoordinate) -> dict:
@@ -715,6 +776,35 @@ class FusedFit:
         arrays are tiny [d] vectors; embedding them as program constants
         is deliberate)."""
         return self._norms[i]
+
+    # ------------------------------------------------------------------
+    # abstract lowering (the semantic auditor / cost model entry)
+    # ------------------------------------------------------------------
+
+    def trace(self, coords, initial_models=None):
+        """Abstractly trace (never execute) the whole-fit program.
+
+        The slab-materialization outputs enter as ``jax.eval_shape``
+        avals, so no gather runs. This is the ONE operand-assembly path
+        the program auditor (analysis/program.py) and the static cost
+        model (analysis/costmodel.py) share with ``run`` — the audited
+        jaxpr is the production program by construction. Returns the
+        ``jax.stages.Traced`` (``.jaxpr``, ``.lower()``).
+        """
+        ops = self._operands(coords, initial_models)
+        statics = self._statics(coords, initial_models)
+        ebs_avals = jax.eval_shape(
+            self._mat_fn, self._mat_operands(coords)
+        )
+        return self._jit.trace(ops, ebs_avals, statics=statics)
+
+    def lower(self, coords, initial_models=None):
+        """Lower (never execute) the whole-fit program for these coords."""
+        return self.trace(coords, initial_models).lower()
+
+    def lower_materialize(self, coords):
+        """Lower (never execute) the slab materialization program."""
+        return self._mat_jit.lower(self._mat_operands(coords))
 
     # ------------------------------------------------------------------
     # the public entry

@@ -1,16 +1,25 @@
-"""photon_tpu.analysis — a JAX-aware static lint pass that gates the package.
+"""photon_tpu.analysis — two static-analysis tiers that gate the package.
 
-Pure-``ast`` (nothing analyzed is imported, no JAX needed at analysis
-time), so it runs in milliseconds on any machine. The rule set encodes the
-failure modes that silently destroy TPU performance or correctness and
-that this repo has actually hit: hidden host syncs inside jitted code,
-numpy-on-tracer calls, recompile-triggering jit misuse, float64 leaking
-into float32 pipelines, int32 index arithmetic near 2^31, and leftover
-debugging debris.
+Tier 1 is a pure-``ast`` lint pass (nothing analyzed is imported, no JAX
+needed at analysis time), so it runs in milliseconds on any machine. The
+rule set encodes the failure modes that silently destroy TPU performance
+or correctness and that this repo has actually hit: hidden host syncs
+inside jitted code, numpy-on-tracer calls, recompile-triggering jit
+misuse, float64 leaking into float32 pipelines, int32 index arithmetic
+near 2^31, and leftover debugging debris.
+
+Tier 2 (``--semantic``; analysis/program.py) audits the PROGRAMS the
+package builds rather than the source text: the public jitted entry
+points are traced under abstract shapes (no device execution — CPU CI is
+enough) and the jaxprs/lowered HLO are checked against contracts each
+audited module declares (dispatch census, recompile-key stability,
+host-boundary and f64 audits, mesh sharding, and a static FLOP/HBM cost
+model for the roofline numbers bench.py compares against).
 
 Usage::
 
-    python -m photon_tpu.analysis photon_tpu/            # gate: exit 0/1
+    python -m photon_tpu.analysis photon_tpu/            # tier-1 gate
+    python -m photon_tpu.analysis --semantic             # tier-2 gate
     python -m photon_tpu.analysis --list-rules
     python -m photon_tpu.analysis --format json photon_tpu/data/
 

@@ -1,5 +1,13 @@
 """CLI: ``python -m photon_tpu.analysis [paths...]``.
 
+Two tiers share this entry point:
+
+- default: the tier-1 pure-``ast`` lint pass over source files;
+- ``--semantic``: the tier-2 program auditor (analysis/program.py) —
+  traces the package's jitted entry points under abstract shapes and
+  audits jaxprs/HLO against the modules' declared contracts. Needs JAX
+  (CPU is fine; no device execution) but no accelerator.
+
 Exit codes: 0 clean (or only suppressed findings), 1 unsuppressed
 findings, 2 usage error.
 """
@@ -7,6 +15,7 @@ findings, 2 usage error.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -54,11 +63,36 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="print the registered rules and exit",
     )
+    parser.add_argument(
+        "--semantic",
+        action="store_true",
+        help="run the tier-2 program auditor (jaxpr/HLO contracts) "
+        "instead of the source lint",
+    )
+    parser.add_argument(
+        "--cost-out",
+        metavar="PATH",
+        help="with --semantic: also write the per-program cost-model/"
+        "roofline report to PATH as JSON",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
         print(render_rule_list())
         return 0
+
+    if args.cost_out and not args.semantic:
+        print("--cost-out requires --semantic", file=sys.stderr)
+        return 2
+    if args.semantic:
+        if args.paths or args.select:
+            print(
+                "--semantic audits the package's declared program "
+                "contracts; paths/--select do not apply",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_semantic(args)
 
     paths = args.paths or ["photon_tpu"]
     select = (
@@ -100,6 +134,43 @@ def main(argv: list[str] | None = None) -> int:
         out = render_text(findings, show_suppressed=args.show_suppressed)
         if out:
             print(out)
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+def _run_semantic(args) -> int:
+    from photon_tpu.analysis import program
+
+    # Cost analysis only where it is consumed: the plain text gate
+    # prints signatures/notes, so pricing every program there is waste.
+    findings, report = program.audit(
+        with_cost=bool(args.cost_out or args.format == "json")
+    )
+    if args.cost_out:
+        from photon_tpu.analysis import costmodel
+
+        costmodel.write_report(args.cost_out, report)
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_json() for f in findings],
+                    "report": report,
+                },
+                indent=2,
+            )
+        )
+    else:
+        out = render_text(findings, show_suppressed=args.show_suppressed)
+        if out:
+            print(out)
+        for cname, entry in report["contracts"].items():
+            progs = ", ".join(
+                f"{n}@{p['signature'][:8]}"
+                for n, p in entry["programs"].items()
+            )
+            print(f"contract {cname}: {progs or 'no traced programs'}")
+            for note in entry["notes"]:
+                print(f"  note: {note}")
     return 1 if any(not f.suppressed for f in findings) else 0
 
 

@@ -1,0 +1,102 @@
+"""Static per-program cost model: FLOPs / HBM bytes from lowered HLO.
+
+``jax.stages.Lowered.cost_analysis()`` runs XLA's HLO cost analysis over
+the *unoptimized* module — no compilation, no device — and returns FLOP
+and bytes-accessed counts per program. Dividing by the target chip's
+peaks gives a roofline lower bound on runtime per dispatch, which is the
+number ``bench.py`` compares measured throughput against
+(measured-vs-predicted utilization).
+
+These are COMPILER counts, not the analytic model-FLOP counts in
+``bench.py`` (which exclude padding): the two deliberately bracket the
+truth — cost_analysis counts every padded lane the program will really
+execute, the analytic count only the useful model work.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Mapping
+
+# Per-chip peaks used for the roofline summary. v5e is the repo's target
+# part (bench.py uses the same numbers for measured utilization).
+CHIP_PEAKS = {
+    "tpu_v5e": {"flops_per_sec": 197e12, "hbm_bytes_per_sec": 819e9},
+}
+DEFAULT_CHIP = "tpu_v5e"
+
+
+def program_cost(lowered: Any) -> dict[str, float]:
+    """Normalized cost counters for one lowered program.
+
+    Returns ``{"flops", "hbm_bytes", "transcendentals"}`` (floats, 0.0 for
+    counters the backend does not report). ``cost_analysis`` may return a
+    dict or a one-element list of dicts depending on the jax version, and
+    some backends return None — all normalized here.
+    """
+    ca = lowered.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, Mapping):
+        return {"flops": 0.0, "hbm_bytes": 0.0, "transcendentals": 0.0}
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "hbm_bytes": float(ca.get("bytes accessed", 0.0)),
+        "transcendentals": float(ca.get("transcendentals", 0.0)),
+    }
+
+
+def roofline(
+    cost: Mapping[str, float], chip: str = DEFAULT_CHIP
+) -> dict[str, Any]:
+    """Roofline classification of one program's cost counters.
+
+    ``min_seconds`` is the per-dispatch lower bound at the chip's peaks;
+    ``bound`` names the resource that sets it. Arithmetic intensity below
+    the chip's ridge point (peak_flops / peak_hbm) means HBM-bound — the
+    expected regime for GLM training (bench.py module docstring).
+    """
+    peaks = CHIP_PEAKS[chip]
+    flops = float(cost.get("flops", 0.0))
+    bytes_ = float(cost.get("hbm_bytes", 0.0))
+    t_flops = flops / peaks["flops_per_sec"]
+    t_hbm = bytes_ / peaks["hbm_bytes_per_sec"]
+    return {
+        "chip": chip,
+        "arithmetic_intensity": (flops / bytes_) if bytes_ else None,
+        "min_seconds_flops": t_flops,
+        "min_seconds_hbm": t_hbm,
+        "min_seconds": max(t_flops, t_hbm),
+        "bound": "flops" if t_flops >= t_hbm else "hbm",
+    }
+
+
+def program_report(
+    lowered: Any, chip: str = DEFAULT_CHIP
+) -> dict[str, Any]:
+    """cost + roofline for one lowered program (bench/report entry)."""
+    cost = program_cost(lowered)
+    out = dict(cost)
+    out["roofline"] = roofline(cost, chip)
+    return out
+
+
+def fused_fit_report(
+    fused: Any, coords: dict, chip: str = DEFAULT_CHIP
+) -> dict[str, Any]:
+    """Per-program predicted cost of one FusedFit generation.
+
+    Lowers (never executes) the whole-fit program and the slab
+    materialization program for the given coordinate structure — the two
+    dispatches of a fused fit — and returns
+    ``{program_name: {flops, hbm_bytes, roofline}}``.
+    """
+    return {
+        "fused_fit": program_report(fused.lower(coords), chip),
+        "materialize": program_report(fused.lower_materialize(coords), chip),
+    }
+
+
+def write_report(path: str, report: Mapping[str, Any]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=2, sort_keys=True)

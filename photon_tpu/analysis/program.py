@@ -1,0 +1,1053 @@
+"""Tier-2 semantic auditor: jaxpr/HLO program contracts for photon_tpu.
+
+Where the tier-1 rules (``rules.py``) read SOURCE TEXT, this tier audits
+the PROGRAMS the package actually builds: the public jitted entry points
+are traced under abstract shapes (``jax.jit(...).trace`` / ``.lower()`` —
+no device execution, so the whole pass runs on CPU CI) and the resulting
+jaxprs / lowered HLO are checked against contracts DECLARED NEXT TO THE
+CODE they constrain (each audited module carries a ``PROGRAM_AUDIT``
+declaration; this module owns the tracing machinery).
+
+Checks (rule ids):
+
+- ``program-dispatch-census``: the number of distinct traced programs
+  across a contract's declared config grid must stay within the declared
+  bound — a config family that should re-enter one executable (the λ-grid
+  warm-start ladder) must not mint new programs.
+- ``program-recompile-key``: per config family, the trace signature either
+  MUST be stable (``stable_under``) or MUST change (``recompiles_on`` —
+  a declared static specialization that stops specializing means the
+  declaration went stale). The report names which argument perturbs the
+  key.
+- ``program-host-boundary``: no callback primitives inside hot-loop
+  jaxprs — a ``pure_callback``/``io_callback``/``debug_callback`` in a
+  fit program is a host round trip per dispatch, the jaxpr-level twin of
+  tier-1's ``host-sync-in-jit``.
+- ``program-f64-cast``: no ``convert_element_type`` TO float64 anywhere
+  in an audited jaxpr (tier-1's ``float64-literal``, after tracing).
+- ``program-sharding``: mesh entry points must carry the declared
+  ``NamedSharding`` axis on every hot-loop operand, replicate exactly the
+  operands declared replicated, and lower to HLO whose collectives are a
+  subset of the declared set (an unplanned all-gather is a silent
+  cross-device transfer per dispatch).
+- ``program-contract``: registry integrity — a contract whose builder
+  raises is a finding, never a silent skip.
+
+Findings reuse :class:`photon_tpu.analysis.core.Finding` (path is the
+contract name) so the text/JSON reporters and the suppression audit work
+unchanged. Suppressions are PER CONTRACT, declared in the contract's
+``suppress`` mapping with a written reason.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import importlib
+import os
+import sys
+from typing import Any, Callable, Iterable, Iterator
+
+from photon_tpu.analysis.core import Finding
+
+SEMANTIC_RULES: dict[str, str] = {
+    "program-dispatch-census": (
+        "distinct compiled programs across a config grid exceed the "
+        "contract's bound"
+    ),
+    "program-recompile-key": (
+        "a config family perturbs (or stops perturbing) a compile-cache "
+        "key against its declaration"
+    ),
+    "program-host-boundary": (
+        "callback primitive inside a hot-loop jaxpr (host round trip "
+        "per dispatch)"
+    ),
+    "program-f64-cast": (
+        "convert_element_type to float64 inside an audited jaxpr"
+    ),
+    "program-sharding": (
+        "mesh operand lost its NamedSharding axis, or lowered HLO "
+        "carries undeclared collectives"
+    ),
+    "program-contract": "contract declaration or builder integrity error",
+}
+
+# Modules that declare program contracts (each exports PROGRAM_AUDIT —
+# one declaration dict or a list of them). The declarations are plain
+# data so importing the audited modules stays free of analysis imports.
+DECLARING_MODULES = (
+    "photon_tpu.algorithm.fused_fit",
+    "photon_tpu.estimators.game_estimator",
+    "photon_tpu.ops.newton_kernel",
+    "photon_tpu.parallel.mesh",
+)
+
+_CALLBACK_PRIMITIVES = frozenset(
+    {
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+        "callback",
+        "outside_call",
+        "host_callback_call",
+    }
+)
+
+_COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+    "reduce-scatter",
+    "collective-broadcast",
+)
+
+
+# --------------------------------------------------------------------------
+# data model
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TracedProgram:
+    """One traced entry point: its jaxpr (for the boundary walk), the
+    jaxpr text hash (the recompile-key proxy: two configs tracing to
+    different jaxprs get different compiled programs), and optionally the
+    Lowered for HLO/cost checks."""
+
+    name: str
+    text: str
+    jaxpr: Any | None = None  # ClosedJaxpr; None for key-only programs
+    lowered: Any | None = None
+
+    @property
+    def signature(self) -> str:
+        return hashlib.sha1(self.text.encode("utf-8")).hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class ContractTrace:
+    """Everything a contract's builder hands the checks.
+
+    ``variants`` maps a config-family name to one signature-dict per
+    generated config (program name -> signature); ``opshardings`` /
+    ``replicated`` / ``collectives`` feed the sharding audit (None when
+    the builder ran single-device); ``notes`` surface in the report.
+    """
+
+    programs: dict[str, TracedProgram]
+    variants: dict[str, list[dict[str, str]]] = dataclasses.field(
+        default_factory=dict
+    )
+    opshardings: dict[str, str] | None = None
+    collectives: list[str] | None = None
+    notes: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramContract:
+    name: str
+    entry: str  # human-readable entry-point path (report/docs)
+    build: Callable[[], ContractTrace]
+    max_programs: int | None = None
+    stable_under: tuple[str, ...] = ()
+    recompiles_on: tuple[str, ...] = ()
+    hot_loop: bool = False
+    sharded_operands: tuple[str, ...] = ()
+    replicated_operands: tuple[str, ...] = ()
+    axis: str | None = None
+    allowed_collectives: tuple[str, ...] = ()
+    suppress: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _finding(contract: ProgramContract, rule: str, message: str) -> Finding:
+    return Finding(
+        rule=rule, path=f"<{contract.name}>", line=0, col=0, message=message
+    )
+
+
+# --------------------------------------------------------------------------
+# jaxpr utilities
+# --------------------------------------------------------------------------
+
+
+def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+    """Every equation of a (Closed)Jaxpr, recursing into sub-jaxprs held
+    in eqn params (scan/while/cond bodies, pjit calls, custom calls)."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for sub in _param_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def _param_jaxprs(params: dict) -> Iterator[Any]:
+    for v in params.values():
+        for cand in v if isinstance(v, (list, tuple)) else (v,):
+            if hasattr(cand, "eqns") or hasattr(cand, "jaxpr"):
+                if hasattr(getattr(cand, "jaxpr", cand), "eqns"):
+                    yield cand
+
+
+def trace_program(name: str, fn: Any, *args: Any, **kwargs: Any) -> TracedProgram:
+    """Trace ``jax.jit(fn)`` (or an already-jitted fn) abstractly.
+
+    ``args`` may mix concrete arrays and ``jax.ShapeDtypeStruct`` leaves;
+    nothing executes. The Lowered is captured for cost/HLO analysis.
+    """
+    import jax
+
+    jitted = fn if hasattr(fn, "trace") else jax.jit(fn)
+    traced = jitted.trace(*args, **kwargs)
+    return TracedProgram(
+        name=name,
+        text=str(traced.jaxpr),
+        jaxpr=traced.jaxpr,
+        lowered=traced.lower(),
+    )
+
+
+# --------------------------------------------------------------------------
+# checks
+# --------------------------------------------------------------------------
+
+
+def check_dispatch_census(
+    contract: ProgramContract, trace: ContractTrace
+) -> Iterator[Finding]:
+    if contract.max_programs is None:
+        return
+    sigs: dict[str, str] = {
+        p.signature: f"{name} (base)" for name, p in trace.programs.items()
+    }
+    for fam in contract.stable_under:
+        for i, cfg in enumerate(trace.variants.get(fam, [])):
+            for name, sig in cfg.items():
+                sigs.setdefault(sig, f"{name} ({fam}[{i}])")
+    if len(sigs) > contract.max_programs:
+        yield _finding(
+            contract,
+            "program-dispatch-census",
+            f"{len(sigs)} distinct compiled programs across the declared "
+            f"config grid, contract allows {contract.max_programs}: "
+            + ", ".join(sorted(sigs.values())),
+        )
+
+
+def check_recompile_key(
+    contract: ProgramContract, trace: ContractTrace
+) -> Iterator[Finding]:
+    base = {name: p.signature for name, p in trace.programs.items()}
+    for fam in contract.stable_under:
+        if not trace.variants.get(fam):
+            # Same integrity rule as recompiles_on below: a declared
+            # family with no generated variants is an UNCHECKED
+            # stability guarantee, not a passing one.
+            yield _finding(
+                contract,
+                "program-contract",
+                f"declared stable family '{fam}' generated no "
+                "variants — the stability guarantee is unchecked",
+            )
+            continue
+        for i, cfg in enumerate(trace.variants.get(fam, [])):
+            moved = sorted(
+                name
+                for name, sig in cfg.items()
+                if name in base and sig != base[name]
+            )
+            if moved:
+                yield _finding(
+                    contract,
+                    "program-recompile-key",
+                    f"config family '{fam}' (variant {i}) perturbs the "
+                    f"compile key of {', '.join(moved)} — these configs "
+                    "must re-enter the same executable",
+                )
+    for fam in contract.recompiles_on:
+        variants = trace.variants.get(fam, [])
+        if not variants:
+            yield _finding(
+                contract,
+                "program-contract",
+                f"declared recompile family '{fam}' generated no "
+                "variants — the declaration is unchecked",
+            )
+            continue
+        if all(
+            all(sig == base.get(name) for name, sig in cfg.items())
+            for cfg in variants
+        ):
+            yield _finding(
+                contract,
+                "program-recompile-key",
+                f"declared recompile trigger '{fam}' no longer perturbs "
+                "any program key — the static specialization it documents "
+                "is gone; tighten the contract declaration",
+            )
+
+
+def check_host_boundary(
+    contract: ProgramContract, trace: ContractTrace
+) -> Iterator[Finding]:
+    import numpy as np
+
+    f64 = np.dtype("float64")
+    for name, prog in trace.programs.items():
+        if prog.jaxpr is None:
+            continue
+        seen_cb: set[str] = set()
+        seen_f64 = False
+        for eqn in iter_eqns(prog.jaxpr):
+            pname = eqn.primitive.name
+            if contract.hot_loop and pname in _CALLBACK_PRIMITIVES:
+                if pname not in seen_cb:
+                    seen_cb.add(pname)
+                    yield _finding(
+                        contract,
+                        "program-host-boundary",
+                        f"program '{name}' carries host-callback "
+                        f"primitive '{pname}' in its hot-loop jaxpr — "
+                        "one host round trip per dispatch",
+                    )
+            if not seen_f64 and pname == "convert_element_type":
+                new = eqn.params.get("new_dtype")
+                if new is not None and np.dtype(new) == f64:
+                    seen_f64 = True
+                    yield _finding(
+                        contract,
+                        "program-f64-cast",
+                        f"program '{name}' converts to float64 in its "
+                        "traced jaxpr (2x HBM + off the TPU fast path)",
+                    )
+
+
+def check_sharding(
+    contract: ProgramContract, trace: ContractTrace
+) -> Iterator[Finding]:
+    if not (contract.sharded_operands or contract.replicated_operands):
+        return
+    if trace.opshardings is None:
+        # Builder ran single-device; the note in the report says so.
+        return
+    for op in contract.sharded_operands:
+        spec = trace.opshardings.get(op)
+        if spec is None:
+            yield _finding(
+                contract,
+                "program-sharding",
+                f"operand '{op}' missing from the sharding trace",
+            )
+        elif contract.axis and f"'{contract.axis}'" not in spec:
+            yield _finding(
+                contract,
+                "program-sharding",
+                f"operand '{op}' lost the '{contract.axis}' mesh axis "
+                f"(sharding is {spec}) — unplanned replication",
+            )
+    for op in contract.replicated_operands:
+        spec = trace.opshardings.get(op)
+        if spec is None:
+            yield _finding(
+                contract,
+                "program-sharding",
+                f"operand '{op}' missing from the sharding trace",
+            )
+        elif contract.axis and f"'{contract.axis}'" in spec:
+            yield _finding(
+                contract,
+                "program-sharding",
+                f"operand '{op}' is declared replicated but carries the "
+                f"'{contract.axis}' axis ({spec})",
+            )
+    undeclared = sorted(
+        set(trace.collectives or ()) - set(contract.allowed_collectives)
+    )
+    if undeclared:
+        yield _finding(
+            contract,
+            "program-sharding",
+            "lowered HLO carries undeclared cross-device transfer op(s): "
+            + ", ".join(undeclared)
+            + f" (declared: {', '.join(contract.allowed_collectives) or 'none'})",
+        )
+
+
+CHECKS = (
+    check_dispatch_census,
+    check_recompile_key,
+    check_host_boundary,
+    check_sharding,
+)
+
+
+def run_checks(
+    contract: ProgramContract, trace: ContractTrace
+) -> list[Finding]:
+    """All checks over one contract's trace, suppressions applied."""
+    findings: list[Finding] = []
+    for check in CHECKS:
+        for f in check(contract, trace):
+            reason = contract.suppress.get(f.rule)
+            if reason is not None:
+                f = dataclasses.replace(
+                    f, suppressed=True, suppress_reason=reason
+                )
+            findings.append(f)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# collective HLO census (shared by the mesh builder and tests)
+# --------------------------------------------------------------------------
+
+
+def hlo_collectives(compiled: Any) -> list[str]:
+    """Collective op names present in a compiled executable's HLO text."""
+    txt = compiled.as_text()
+    return sorted(op for op in _COLLECTIVE_OPS if op in txt)
+
+
+# --------------------------------------------------------------------------
+# shared tiny workload (abstract-trace fixtures; CPU-cheap)
+# --------------------------------------------------------------------------
+
+
+def _l2_config(weight: float, optimizer=None, variance=None):
+    from photon_tpu import optim
+    from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
+
+    kw: dict[str, Any] = dict(
+        regularization=optim.RegularizationContext(
+            optim.RegularizationType.L2
+        ),
+        regularization_weight=weight,
+    )
+    if optimizer is not None:
+        kw["optimizer"] = optimizer
+    if variance is not None:
+        kw["variance_computation"] = variance
+    return GLMOptimizationConfiguration(**kw)
+
+
+def _tiny_glmix(num_iterations: int = 2, n: int = 96, e: int = 7):
+    """A miniature single-device GLMix estimator + dataset: one dense
+    fixed effect and one random effect, logistic task — the smallest
+    structure that exercises every fused-fit program family."""
+    import numpy as np
+
+    from photon_tpu.data.dataset import DenseFeatures
+    from photon_tpu.data.game_data import make_game_dataset
+    from photon_tpu.data.random_effect import RandomEffectDataConfiguration
+    from photon_tpu.estimators.game_estimator import (
+        FixedEffectCoordinateConfiguration,
+        GameEstimator,
+        RandomEffectCoordinateConfiguration,
+    )
+    from photon_tpu.types import TaskType
+
+    d, du = 5, 4
+    rng = np.random.default_rng(20260803)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    x[:, -1] = 1.0
+    xu = rng.normal(size=(n, du)).astype(np.float32)
+    xu[:, -1] = 1.0
+    users = rng.integers(0, e, size=n)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    data = make_game_dataset(
+        y,
+        {"global": DenseFeatures(x), "userShard": DenseFeatures(xu)},
+        id_tags={"userId": users},
+    )
+    est = GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {
+            "global": FixedEffectCoordinateConfiguration(
+                "global", _l2_config(0.01)
+            ),
+            "per-user": RandomEffectCoordinateConfiguration(
+                RandomEffectDataConfiguration("userId", "userShard"),
+                _l2_config(0.5),
+            ),
+        },
+        intercept_indices={"global": d - 1, "userShard": du - 1},
+        num_iterations=num_iterations,
+        mesh="off",
+    )
+    return est, data
+
+
+def _zero_initial_models(coords: dict) -> dict:
+    """Warm-start models with the right structure (values never matter —
+    tracing sees only avals — but has_init flips the statics)."""
+    import jax.numpy as jnp
+
+    from photon_tpu.algorithm.coordinate import FixedEffectCoordinate
+    from photon_tpu.models.game import FixedEffectModel, RandomEffectModel
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+
+    out = {}
+    for cid, coord in coords.items():
+        inner = getattr(coord, "inner", coord)
+        if isinstance(inner, FixedEffectCoordinate):
+            glm = GeneralizedLinearModel(
+                Coefficients(
+                    means=jnp.zeros(
+                        inner.batch.num_features, inner.batch.labels.dtype
+                    )
+                ),
+                inner.problem.task,
+            )
+            out[cid] = FixedEffectModel(glm, coord.feature_shard_id)
+        else:
+            ds = inner.dataset
+            out[cid] = RandomEffectModel(
+                coefficients=jnp.zeros(
+                    (ds.num_entities, ds.max_sub_dim), ds.dtype
+                ),
+                random_effect_type=ds.config.random_effect_type,
+                feature_shard_id=ds.config.feature_shard_id,
+                task=inner.task,
+                proj_all=ds.proj_all,
+                entity_keys=ds.entity_keys,
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# contract builders (named by the PROGRAM_AUDIT declarations)
+# --------------------------------------------------------------------------
+
+
+def build_fused_fit() -> ContractTrace:
+    """Trace the three programs of one fused-fit generation and the config
+    families of the λ-grid / optimizer-swap discipline."""
+    from photon_tpu import optim
+    from photon_tpu.algorithm.fused_fit import FusedFit
+
+    est, data = _tiny_glmix()
+    datasets, _ = est.prepare(data)
+    n = data.num_samples
+
+    def fused_for(opt_configs: dict, iters: int = 2):
+        coords = est._build_coordinates(
+            datasets, opt_configs, {}, logical_rows=n
+        )
+        return FusedFit(coords, est.update_sequence, iters, set()), coords
+
+    def fit_trace(
+        fused: FusedFit, coords: dict, initial_models=None, lower=True
+    ):
+        # FusedFit.trace is the SAME operand assembly run() uses — the
+        # audited jaxpr is the production program by construction.
+        traced = fused.trace(coords, initial_models)
+        return TracedProgram(
+            name="fit",
+            text=str(traced.jaxpr),
+            jaxpr=traced.jaxpr,
+            lowered=traced.lower() if lower else None,
+        )
+
+    fused, coords = fused_for({})
+    mat = trace_program(
+        "materialize", fused._mat_jit, fused._mat_operands(coords)
+    )
+    fit_cold = fit_trace(fused, coords)
+    warm = _zero_initial_models(coords)
+    fit_warm = dataclasses.replace(
+        fit_trace(fused, coords, warm), name="fit_warm"
+    )
+
+    variants: dict[str, list[dict[str, str]]] = {
+        "lambda_grid": [],
+        "optimizer_swap": [],
+        "iteration_count": [],
+    }
+    for w in (0.003, 3.0):
+        f2, c2 = fused_for(
+            {"global": _l2_config(w), "per-user": _l2_config(w)}
+        )
+        variants["lambda_grid"].append(
+            {
+                "fit": fit_trace(f2, c2, lower=False).signature,
+                "fit_warm": fit_trace(
+                    f2, c2, _zero_initial_models(c2), lower=False
+                ).signature,
+            }
+        )
+    f3, c3 = fused_for(
+        {
+            "global": _l2_config(
+                0.01, optimizer=optim.OptimizerConfig.tron()
+            )
+        }
+    )
+    variants["optimizer_swap"].append(
+        {"fit": fit_trace(f3, c3, lower=False).signature}
+    )
+    f4, c4 = fused_for({}, iters=3)
+    variants["iteration_count"].append(
+        {"fit": fit_trace(f4, c4, lower=False).signature}
+    )
+
+    return ContractTrace(
+        programs={
+            "materialize": mat,
+            "fit": fit_cold,
+            "fit_warm": fit_warm,
+        },
+        variants=variants,
+        notes=[
+            "a fused fit is 2 dispatches (materialize once per dataset "
+            "generation + the whole-fit program); the warm-start entry is "
+            "a third distinct executable of the same generation",
+        ],
+    )
+
+
+def build_fused_cache_keys() -> ContractTrace:
+    """The estimator's static-key discipline, checked on keys alone: a
+    λ grid maps to ONE fused-cache entry, an optimizer swap to a second,
+    and a realistic mixed grid stays within the LRU bound."""
+    from photon_tpu import optim
+    from photon_tpu.algorithm.fused_fit import fused_static_key
+    from photon_tpu.estimators.game_estimator import _FUSED_CACHE_SIZE
+
+    est, data = _tiny_glmix()
+    datasets, _ = est.prepare(data)
+    n = data.num_samples
+
+    def key_for(opt_configs: dict) -> str:
+        coords = est._build_coordinates(
+            datasets, opt_configs, {}, logical_rows=n
+        )
+        return str(
+            fused_static_key(
+                coords,
+                est.update_sequence,
+                est.num_iterations,
+                est.locked_coordinates,
+            )
+        )
+
+    base = TracedProgram(name="fused_static_key", text=key_for({}))
+    lam = [
+        {"fused_static_key": TracedProgram("k", key_for(
+            {"global": _l2_config(w), "per-user": _l2_config(w)}
+        )).signature}
+        for w in (1e-4, 0.01, 1.0, 100.0)
+    ]
+    swap = [
+        {"fused_static_key": TracedProgram("k", key_for(
+            {"global": _l2_config(
+                0.01, optimizer=optim.OptimizerConfig.tron()
+            )}
+        )).signature}
+    ]
+    mixed = {sig["fused_static_key"] for sig in lam + swap} | {
+        base.signature
+    }
+    notes = [
+        f"mixed λ×optimizer grid occupies {len(mixed)} of "
+        f"{_FUSED_CACHE_SIZE} fused-cache slots",
+    ]
+    trace = ContractTrace(
+        programs={"fused_static_key": base},
+        variants={"lambda_grid": lam, "optimizer_swap": swap},
+        notes=notes,
+    )
+    if len(mixed) > _FUSED_CACHE_SIZE:
+        trace.notes.append(
+            "mixed grid exceeds the fused-cache LRU capacity — "
+            "alternating configs will rebuild whole-fit traces"
+        )
+    return trace
+
+
+def build_unfused_update() -> ContractTrace:
+    """The unfused coordinate update (_run_impl under jit): λ and warm
+    starts are traced operands — ONE executable per static config."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_tpu import optim
+    from photon_tpu.algorithm.problems import (
+        VarianceComputationType,
+        _run_jit,
+    )
+    from photon_tpu.data.dataset import make_dense_batch
+    from photon_tpu.ops.normalization import NormalizationContext
+    from photon_tpu.types import TaskType
+
+    n, d = 64, 5
+    rng = np.random.default_rng(0)
+    batch = make_dense_batch(
+        rng.normal(size=(n, d)).astype(np.float32),
+        (rng.uniform(size=n) < 0.5).astype(np.float32),
+    )
+    norm = NormalizationContext()
+
+    def tr(l2: float, opt_config=None, w0=None) -> TracedProgram:
+        dtype = batch.labels.dtype
+        return trace_program(
+            "coordinate_update",
+            _run_jit,
+            batch,
+            (jnp.zeros(d, dtype) if w0 is None else w0),
+            jnp.asarray(0.0, dtype),
+            jnp.asarray(l2, dtype),
+            norm,
+            None,
+            jnp.asarray(1.0, dtype),
+            task=TaskType.LOGISTIC_REGRESSION,
+            opt_config=opt_config or optim.OptimizerConfig(),
+            use_owlqn=False,
+            intercept_index=d - 1,
+            variance_computation=VarianceComputationType.NONE,
+        )
+
+    base = tr(0.01)
+    warm = jax.numpy.ones(d, batch.labels.dtype)
+    return ContractTrace(
+        programs={"coordinate_update": base},
+        variants={
+            "lambda_grid": [
+                {"coordinate_update": tr(w).signature} for w in (1e-3, 10.0)
+            ],
+            "warm_start": [
+                {"coordinate_update": tr(0.01, w0=warm).signature}
+            ],
+            "optimizer_swap": [
+                {
+                    "coordinate_update": tr(
+                        0.01, opt_config=optim.OptimizerConfig.tron()
+                    ).signature
+                }
+            ],
+        },
+    )
+
+
+def build_newton_kernel() -> ContractTrace:
+    """The Pallas Newton-step wrapper, traced through the interpreter
+    path on non-TPU backends (Mosaic lowering is TPU-only)."""
+    import jax
+
+    from photon_tpu.ops.newton_kernel import (
+        LANES,
+        interpret_required,
+        newton_step_lanes,
+    )
+    from photon_tpu.types import TaskType
+
+    s, r, bp = 4, 6, LANES
+    f32 = "float32"
+
+    def sds(*shape):
+        return jax.ShapeDtypeStruct(shape, f32)
+
+    def tr(name: str, *, s=s, r=r, trials=16) -> TracedProgram:
+        return trace_program(
+            name,
+            newton_step_lanes,
+            sds(s, r, bp), sds(s, bp), sds(r, bp), sds(r, bp), sds(r, bp),
+            sds(s, bp), sds(s, bp), sds(s, bp), sds(1, bp),
+            r=r, s=s,
+            task=TaskType.LOGISTIC_REGRESSION,
+            trials=trials,
+            interpret=interpret_required(),
+        )
+
+    base = tr("newton_step")
+    return ContractTrace(
+        programs={"newton_step": base},
+        variants={
+            "bucket_shape": [{"newton_step": tr("n", r=r + 2).signature}],
+            "line_search_trials": [
+                {"newton_step": tr("n", trials=8).signature}
+            ],
+        },
+    )
+
+
+def build_mesh_sharding() -> ContractTrace:
+    """Mesh entry points: the data-parallel GLM objective over a sharded
+    batch, plus the random-effect dataset placement rules — checked from
+    the placed arrays' NamedShardings and the compiled HLO's collectives.
+    Includes the reasoned report of why the fused path rejects meshes."""
+    import jax
+    import numpy as np
+
+    from photon_tpu.algorithm.fused_fit import fuse_ineligibility_reasons
+    from photon_tpu.data.dataset import make_dense_batch
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfiguration,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.ops import losses as losses_mod
+    from photon_tpu.ops import glm as glm_ops
+    from photon_tpu.ops.normalization import NormalizationContext
+    from photon_tpu.parallel.mesh import (
+        make_mesh,
+        replicated,
+        shard_batch,
+        shard_random_effect_dataset,
+    )
+    from photon_tpu.types import TaskType
+
+    if len(jax.devices()) < 2:
+        return ContractTrace(
+            programs={},
+            notes=[
+                "sharding audit SKIPPED: single visible device (run under "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8, as "
+                "CI does, to exercise it)",
+            ],
+        )
+
+    mesh = make_mesh()
+    n_dev = len(mesh.devices.reshape(-1))
+    n, d = 8 * n_dev, 5
+    rng = np.random.default_rng(1)
+    batch = shard_batch(
+        make_dense_batch(
+            rng.normal(size=(n, d)).astype(np.float32),
+            (rng.uniform(size=n) < 0.5).astype(np.float32),
+        ),
+        mesh,
+    )
+    loss = losses_mod.get_loss(TaskType.LOGISTIC_REGRESSION)
+
+    def objective(b, w):
+        return glm_ops.make_value_and_grad(b, loss, NormalizationContext())(w)
+
+    w = jax.device_put(
+        jax.numpy.zeros(d, batch.labels.dtype), replicated(mesh)
+    )
+    prog = trace_program("sharded_objective", objective, batch, w)
+    collectives = hlo_collectives(prog.lowered.compile())
+
+    opshardings = {
+        "features": str(batch.features.x.sharding.spec),
+        "labels": str(batch.labels.sharding.spec),
+        "offsets": str(batch.offsets.sharding.spec),
+        "weights": str(batch.weights.sharding.spec),
+    }
+
+    # Random-effect placement rules: plan arrays entity-sharded, shared
+    # raw leaves replicated (mesh.shard_random_effect_dataset contract).
+    est, data = _tiny_glmix(n=16 * n_dev, e=2 * n_dev)
+    re_ds = build_random_effect_dataset(
+        data,
+        RandomEffectDataConfiguration("userId", "userShard"),
+        intercept_index=3,
+    )
+    re_ds = shard_random_effect_dataset(re_ds, mesh)
+    b0 = re_ds.blocks[0]
+    opshardings["re_entity_codes"] = str(b0.entity_codes.sharding.spec)
+    opshardings["re_row_ids"] = str(b0.row_ids.sharding.spec)
+    raw = re_ds.raw
+    raw_leaf = getattr(raw, "x", None)
+    if raw_leaf is None:
+        raw_leaf = raw.values
+    opshardings["re_raw"] = str(raw_leaf.sharding.spec)
+
+    # Why the fused whole-fit path refuses this mesh today — the reasoned
+    # report the ROADMAP's multi-device fusion work starts from.
+    datasets, _ = est.prepare(data)
+    coords = est._build_coordinates(datasets, {}, {}, data.num_samples)
+    reasons = fuse_ineligibility_reasons(coords, mesh=mesh)
+    notes = [f"mesh fusion blocked: {r}" for r in reasons] or [
+        "fuse_ineligibility_reasons reports no blockers — revisit the "
+        "estimator's mesh gate"
+    ]
+    return ContractTrace(
+        programs={"sharded_objective": prog},
+        opshardings=opshardings,
+        collectives=collectives,
+        notes=notes,
+    )
+
+
+def build_evaluators() -> ContractTrace:
+    """Evaluation + scoring entry points: shape-specialized (a row-count
+    change recompiles, by design), value-stable, no host callbacks."""
+    import jax
+
+    from photon_tpu.evaluation.evaluators import auc_roc, rmse
+    from photon_tpu.models.glm import Coefficients
+
+    def sds(*shape):
+        return jax.ShapeDtypeStruct(shape, "float32")
+
+    def tr_eval(name, fn, n) -> TracedProgram:
+        return trace_program(name, fn, sds(n), sds(n))
+
+    def score(w, x):
+        from photon_tpu.data.dataset import DenseFeatures
+
+        return Coefficients(means=w).compute_score(DenseFeatures(x))
+
+    base_auc = tr_eval("auc", auc_roc, 256)
+    base_rmse = tr_eval("rmse", rmse, 256)
+    scoring = trace_program("fixed_effect_score", score, sds(5), sds(256, 5))
+    return ContractTrace(
+        programs={
+            "auc": base_auc,
+            "rmse": base_rmse,
+            "fixed_effect_score": scoring,
+        },
+        variants={
+            "row_count": [
+                {
+                    "auc": tr_eval("auc", auc_roc, 512).signature,
+                    "rmse": tr_eval("rmse", rmse, 512).signature,
+                }
+            ],
+        },
+    )
+
+
+_BUILDERS: dict[str, Callable[[], ContractTrace]] = {
+    "build_fused_fit": build_fused_fit,
+    "build_fused_cache_keys": build_fused_cache_keys,
+    "build_unfused_update": build_unfused_update,
+    "build_newton_kernel": build_newton_kernel,
+    "build_mesh_sharding": build_mesh_sharding,
+    "build_evaluators": build_evaluators,
+}
+
+# Contracts owned by the analysis tier itself (no better home module).
+_LOCAL_AUDITS = (
+    dict(
+        name="evaluation-scoring",
+        entry="evaluation.evaluators.auc_roc / rmse; "
+        "models.glm.Coefficients.compute_score",
+        builder="build_evaluators",
+        max_programs=3,
+        recompiles_on=("row_count",),
+        hot_loop=True,
+    ),
+)
+
+
+def contract_from_declaration(spec: dict) -> ProgramContract:
+    builder = spec.get("builder")
+    if builder not in _BUILDERS:
+        raise ValueError(
+            f"PROGRAM_AUDIT declaration {spec.get('name')!r} names unknown "
+            f"builder {builder!r}"
+        )
+    return ProgramContract(
+        name=spec["name"],
+        entry=spec["entry"],
+        build=_BUILDERS[builder],
+        max_programs=spec.get("max_programs"),
+        stable_under=tuple(spec.get("stable_under", ())),
+        recompiles_on=tuple(spec.get("recompiles_on", ())),
+        hot_loop=bool(spec.get("hot_loop", False)),
+        sharded_operands=tuple(spec.get("sharded_operands", ())),
+        replicated_operands=tuple(spec.get("replicated_operands", ())),
+        axis=spec.get("axis"),
+        allowed_collectives=tuple(spec.get("allowed_collectives", ())),
+        suppress=dict(spec.get("suppress", {})),
+    )
+
+
+def collect_contracts() -> list[ProgramContract]:
+    """The repo's declared contract registry (module hooks + local)."""
+    specs: list[dict] = []
+    for modname in DECLARING_MODULES:
+        mod = importlib.import_module(modname)
+        decl = getattr(mod, "PROGRAM_AUDIT", None)
+        if decl is None:
+            raise ValueError(
+                f"{modname} is a declaring module but exports no "
+                "PROGRAM_AUDIT"
+            )
+        specs.extend(decl if isinstance(decl, (list, tuple)) else [decl])
+    specs.extend(_LOCAL_AUDITS)
+    return [contract_from_declaration(s) for s in specs]
+
+
+# --------------------------------------------------------------------------
+# the audit driver
+# --------------------------------------------------------------------------
+
+
+def _ensure_virtual_devices() -> None:
+    """Give the sharding audit a multi-device CPU platform when possible.
+
+    Only effective before jax initializes; harmless on real accelerators
+    (the flag only affects the host platform)."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def audit(
+    contracts: Iterable[ProgramContract] | None = None,
+    *,
+    with_cost: bool = True,
+    chip: str | None = None,
+) -> tuple[list[Finding], dict]:
+    """Run every contract; returns (findings, report).
+
+    The registry builds run under ``disable_x64`` so the audited traces
+    match the production (f32) configuration even when the host process
+    enabled x64 (the test harness does).
+    """
+    _ensure_virtual_devices()
+    from jax.experimental import disable_x64
+
+    from photon_tpu.analysis import costmodel
+
+    if chip is None:
+        chip = costmodel.DEFAULT_CHIP
+    findings: list[Finding] = []
+    report: dict[str, Any] = {"contracts": {}}
+    with disable_x64():
+        resolved = (
+            collect_contracts() if contracts is None else list(contracts)
+        )
+        for contract in resolved:
+            entry: dict[str, Any] = {
+                "entry": contract.entry,
+                "programs": {},
+                "notes": [],
+            }
+            report["contracts"][contract.name] = entry
+            try:
+                trace = contract.build()
+            except Exception as exc:  # noqa: BLE001 — any builder crash is a finding
+                findings.append(
+                    _finding(
+                        contract,
+                        "program-contract",
+                        f"contract builder failed: {exc!r}",
+                    )
+                )
+                continue
+            entry["notes"] = list(trace.notes)
+            for name, prog in trace.programs.items():
+                pentry: dict[str, Any] = {"signature": prog.signature}
+                if with_cost and prog.lowered is not None:
+                    try:
+                        pentry["cost"] = costmodel.program_report(
+                            prog.lowered, chip
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        pentry["cost_error"] = repr(exc)
+                entry["programs"][name] = pentry
+            if trace.opshardings is not None:
+                entry["opshardings"] = dict(trace.opshardings)
+                entry["collectives"] = list(trace.collectives or ())
+            findings.extend(run_checks(contract, trace))
+    findings.sort(key=lambda f: (f.path, f.rule, f.message))
+    return findings, report

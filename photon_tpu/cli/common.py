@@ -20,7 +20,17 @@ def maybe_init_distributed() -> bool:
     """
     import jax
 
-    if jax.distributed.is_initialized():
+    # jax.distributed.is_initialized only exists from jax 0.5; on older
+    # versions read the global client state the accessor wraps.
+    initialized = getattr(jax.distributed, "is_initialized", None)
+    if initialized is None:
+        def initialized() -> bool:
+            try:
+                from jax._src.distributed import global_state
+            except ImportError:  # pragma: no cover — layout moved again
+                return False
+            return getattr(global_state, "client", None) is not None
+    if initialized():
         return False  # idempotent CLI re-entry in one process
     try:
         jax.distributed.initialize()
@@ -36,9 +46,12 @@ def maybe_init_distributed() -> bool:
     except RuntimeError as e:
         # Programmatic re-entry after the XLA backend is already up (tests,
         # notebooks calling main() mid-session): multi-host init is a
-        # process-start decision, so treat as single-host. Anything else
-        # (real cluster misconfiguration) propagates.
-        if "before any JAX calls" in str(e) or "called once" in str(e):
+        # process-start decision, so treat as single-host. The wording has
+        # moved across jax versions ("before any JAX calls" / "before any
+        # JAX computations"); match both. Anything else (real cluster
+        # misconfiguration) propagates.
+        msg = str(e)
+        if ("before any JAX" in msg or "called once" in msg):
             return False
         raise
     logging.getLogger("photon.cli").info(

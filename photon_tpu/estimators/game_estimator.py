@@ -70,6 +70,36 @@ logger = logging.getLogger(__name__)
 # slab HBM. A handful covers realistic mixed-optimizer config grids.
 _FUSED_CACHE_SIZE = 8
 
+# Program contracts (audited by `python -m photon_tpu.analysis
+# --semantic`; machinery in analysis/program.py). The first pins the
+# _fused_cache static-key discipline: a λ-grid sweep maps to ONE cache
+# key (one whole-fit executable re-entered with new traced weights) and
+# only a genuinely-static change (optimizer swap) mints a second. The
+# second pins the unfused coordinate update (_run_impl under jit): λ and
+# warm-start coefficients are traced operands, so one executable serves
+# the entire grid.
+PROGRAM_AUDIT = [
+    dict(
+        name="fused-cache-key",
+        entry="estimators.game_estimator.GameEstimator._fused_for "
+        "(fused_static_key discipline)",
+        builder="build_fused_cache_keys",
+        max_programs=1,
+        stable_under=("lambda_grid",),
+        recompiles_on=("optimizer_swap",),
+    ),
+    dict(
+        name="unfused-coordinate-update",
+        entry="algorithm.problems._run_impl "
+        "(via GLMOptimizationProblem.run)",
+        builder="build_unfused_update",
+        max_programs=1,
+        stable_under=("lambda_grid", "warm_start"),
+        recompiles_on=("optimizer_swap",),
+        hot_loop=True,
+    ),
+]
+
 # Default primary evaluator per task (GameEstimator.scala:673
 # prepareValidationEvaluators falls back to the task's default evaluator).
 _DEFAULT_EVALUATOR = {
@@ -521,15 +551,15 @@ class GameEstimator:
         recompiles), and a grid that ALTERNATES static keys (e.g. mixed
         optimizer configs) round-robins among cached programs instead of
         rebuilding the whole-fit trace on every entry."""
-        if self.resolve_mesh() is not None or self.emitter is not None:
-            return None
         from photon_tpu.algorithm.fused_fit import (
             FusedFit,
-            fuse_eligible,
+            fuse_ineligibility_reasons,
             fused_static_key,
         )
 
-        if not fuse_eligible(coords):
+        if fuse_ineligibility_reasons(
+            coords, mesh=self.resolve_mesh(), emitter=self.emitter
+        ):
             return None
         key = fused_static_key(
             coords, self.update_sequence, self.num_iterations,

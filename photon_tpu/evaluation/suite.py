@@ -90,8 +90,10 @@ class EvaluationSuite:
                     if spec.evaluator_type.value != "AUC":
                         raise NotImplementedError(
                             f"grouped {spec.evaluator_type} not supported "
-                            "(reference MultiEvaluator supports AUC and "
-                            "precision@k)")
+                            "as a summary metric (reference MultiEvaluator "
+                            "supports AUC and precision@k); for per-group "
+                            "values of the supported metrics use "
+                            "EvaluationSuite.evaluate_per_group")
                     val = grouped_auc(z, self.labels, codes, num_groups,
                                       self.weights)
             else:
@@ -122,9 +124,10 @@ class EvaluationSuite:
                 assert spec.evaluator_type is not None
                 if spec.evaluator_type.value != "AUC":
                     raise NotImplementedError(
-                        f"grouped {spec.evaluator_type} not supported "
-                        "(reference MultiEvaluator supports AUC and "
-                        "precision@k)")
+                        f"grouped {spec.evaluator_type} not supported: "
+                        "evaluate_per_group implements AUC and "
+                        "precision@k only (reference MultiEvaluator's "
+                        "grouped metric set)")
                 vals, valid = grouped_auc_per_group(
                     z, self.labels, codes, num_groups, self.weights)
             out[spec.name] = np.where(

@@ -48,6 +48,21 @@ from photon_tpu.types import TaskType
 Array = jax.Array
 
 LANES = 128
+
+# Program contract (audited by `python -m photon_tpu.analysis --semantic`;
+# machinery in analysis/program.py): one Newton step for a bucket shape is
+# ONE program — damping/λ/weights are traced operands; only the bucket
+# shape (r, s) and the line-search trial count are static and may mint a
+# new executable. No host callbacks, no f64, ever: this kernel sits inside
+# the fused fit's per-iteration loop.
+PROGRAM_AUDIT = dict(
+    name="newton-kernel",
+    entry="ops.newton_kernel.newton_step_lanes",
+    builder="build_newton_kernel",
+    max_programs=1,
+    recompiles_on=("bucket_shape", "line_search_trials"),
+    hot_loop=True,
+)
 # x block is [S, R, LANES] f32 in VMEM; stay well under the ~16MB budget
 # (double buffering + scratch + vectors).
 _MAX_RS = 16_384

@@ -28,6 +28,28 @@ from photon_tpu.data.dataset import GLMBatch, pad_batch
 
 DATA_AXIS = "data"
 
+# Program contract (audited by `python -m photon_tpu.analysis --semantic`;
+# machinery in analysis/program.py): every hot-loop operand of a sharded
+# fixed-effect batch carries the DATA_AXIS NamedSharding; random-effect
+# plan arrays shard their entity axis while the shared raw leaves stay
+# replicated; and the lowered data-parallel objective's only collective is
+# the gradient all-reduce — an all-gather appearing here means sharding
+# propagation broke and every dispatch pays a cross-device transfer.
+PROGRAM_AUDIT = dict(
+    name="mesh-sharding",
+    entry="parallel.mesh.shard_batch / shard_random_effect_dataset "
+    "+ ops.glm objective",
+    builder="build_mesh_sharding",
+    hot_loop=True,
+    sharded_operands=(
+        "features", "labels", "offsets", "weights",
+        "re_entity_codes", "re_row_ids",
+    ),
+    replicated_operands=("re_raw",),
+    axis=DATA_AXIS,
+    allowed_collectives=("all-reduce",),
+)
+
 
 def shard_random_effect_dataset(
     ds, mesh: Mesh, *, axis_name: str = DATA_AXIS

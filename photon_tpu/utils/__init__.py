@@ -1,6 +1,14 @@
 """Cross-cutting utilities: section timing + device profiling hooks."""
 
-from photon_tpu.utils.compile_cache import enable_compilation_cache
+from photon_tpu.utils.compile_cache import (
+    cache_stats,
+    enable_compilation_cache,
+)
 from photon_tpu.utils.timed import Timed, profile_trace
 
-__all__ = ["Timed", "enable_compilation_cache", "profile_trace"]
+__all__ = [
+    "Timed",
+    "cache_stats",
+    "enable_compilation_cache",
+    "profile_trace",
+]

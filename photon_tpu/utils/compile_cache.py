@@ -1,10 +1,17 @@
-"""Persistent XLA compilation cache wiring.
+"""Persistent XLA compilation cache wiring + hit/miss instrumentation.
 
 The reference pays no compilation cost (Spark ships interpreted closures);
 the TPU build's analog of that "instant start" is XLA's persistent
 compilation cache: compiled executables keyed by HLO hash land in a local
 directory, so repeated runs of the same shapes (the CLI on a daily cadence,
 the bench, tuner re-entries in fresh processes) skip the compile entirely.
+
+``cache_stats()`` exposes what the cache actually did this process —
+hit/miss counts from JAX's monitoring events plus the on-disk entry
+count/bytes — so ``bench.py`` can report the hit-rate next to
+``warm_cache_e2e_seconds`` (the BENCH_r05 anomaly where the warm rerun was
+SLOWER than cold is unexplainable without knowing whether the cache ever
+hit).
 """
 
 from __future__ import annotations
@@ -14,6 +21,36 @@ import os
 _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "photon_tpu_xla"
 )
+
+# Monitoring event -> counter key. Misses are recorded by
+# jax/_src/compilation_cache.py on a failed lookup; hits by
+# jax/_src/compiler.py when a compiled executable is served from disk.
+_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "persistent_hits",
+    "/jax/compilation_cache/cache_misses": "persistent_misses",
+}
+
+_stats = {"persistent_hits": 0, "persistent_misses": 0}
+_listener_installed = False
+_dir_in_effect: str | None = None
+
+
+def _on_event(event: str, **kwargs) -> None:
+    key = _EVENTS.get(event)
+    if key is not None:
+        _stats[key] += 1
+
+
+def _install_listener() -> None:
+    global _listener_installed
+    if _listener_installed:
+        return
+    import jax.monitoring
+
+    # Listeners are append-only in jax (no unregister API); one
+    # process-lifetime counter hook is the intended use.
+    jax.monitoring.register_event_listener(_on_event)
+    _listener_installed = True
 
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
@@ -26,13 +63,76 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     """
     import jax
 
+    global _dir_in_effect
+
     if cache_dir is None:
         cache_dir = os.environ.get("PHOTON_COMPILE_CACHE", _DEFAULT_DIR)
     if not cache_dir or cache_dir.lower() == "off":
+        # Genuinely disable: a process that enabled the cache earlier
+        # must stop persisting/hitting it, or cache_stats() would report
+        # dir=None while the counters keep climbing.
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_cache_singleton()
+        _dir_in_effect = None
         return None
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # Cache everything that took meaningful compile time; the default
     # threshold (1s) would skip many of the small eager-op programs whose
     # first-compile latency dominates cold starts on remote backends.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    # JAX initializes the cache singleton AT MOST ONCE, on the first
+    # compile: if anything jitted before this call (an import-time eager
+    # op is enough), the singleton latched "no directory" and every
+    # later compile skips the cache silently. Reset so the directory
+    # configured above actually takes effect.
+    _reset_cache_singleton()
+    _install_listener()
+    _dir_in_effect = cache_dir
     return cache_dir
+
+
+def _reset_cache_singleton() -> None:
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover — internal API may move
+        pass
+
+
+def _dir_stats(cache_dir: str) -> tuple[int, int]:
+    entries = 0
+    total = 0
+    try:
+        for de in os.scandir(cache_dir):
+            if de.is_file():
+                entries += 1
+                total += de.stat().st_size
+    except OSError:
+        pass
+    return entries, total
+
+
+def cache_stats() -> dict:
+    """Hit/miss counters + on-disk footprint of the persistent cache.
+
+    ``persistent_hits``/``persistent_misses`` count this process's
+    compile requests served from / missed in the directory cache (a miss
+    is a real compile). ``hit_rate`` is None before any request. The
+    ``entries``/``bytes`` pair is the directory scan at call time — a
+    cross-process view of what the next cold start will find.
+    """
+    hits = _stats["persistent_hits"]
+    misses = _stats["persistent_misses"]
+    total = hits + misses
+    entries, size = (
+        _dir_stats(_dir_in_effect) if _dir_in_effect else (0, 0)
+    )
+    return {
+        "dir": _dir_in_effect,
+        "persistent_hits": hits,
+        "persistent_misses": misses,
+        "hit_rate": (hits / total) if total else None,
+        "entries": entries,
+        "bytes": size,
+    }
