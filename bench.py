@@ -15,14 +15,20 @@ coordinate descent. Two task variants run:
 
 Per variant, phases are measured separately (the reference's Timed sections
 around prepareTrainingDatasets vs CoordinateDescent.run):
-- **ingest**: host-side dataset planning + the single packed plan-buffer
-  transfer;
-- **compile**: the variant's own first fit. The whole coordinate-descent
-  fit is ONE fused XLA program (algorithm/fused_fit.py) plus one slab
-  materialization program, so this is ~2 compiles, not ~20; a persistent
-  compilation cache makes repeat processes cheaper. ``warm_cache_e2e``
-  reports a complete second prepare+fit cycle on freshly built
-  identical-shape data in the same process — the daily-cadence rerun cost.
+- **ingest**: host-side dataset planning (PARALLEL across coordinates and
+  chunked within them, data/pipeline.py) + the chunked packed plan-buffer
+  transfer; the per-stage breakdown (``plan_seconds``,
+  ``transfer_seconds``) rides in ``*_pipeline``;
+- **compile**: the full compile cost actually paid. The whole
+  coordinate-descent fit is ONE fused XLA program (algorithm/fused_fit.py)
+  plus one slab materialization program; since round 6 both AOT-compile on
+  a BACKGROUND thread from shape-predicted skeletons while ingest runs
+  (``compile_overlap_fraction`` reports how much of that compile hid), so
+  ``e2e_seconds`` is the MEASURED wall of prepare + first fit — strictly
+  less than ``ingest_seconds + compile_seconds`` when the overlap is real,
+  never a re-labeled sum. ``warm_cache_e2e`` reports a complete second
+  prepare+fit cycle on freshly built identical-shape data in the same
+  process — the daily-cadence rerun cost.
 - **train**: steady-state coordinate descent, measured as an AGGREGATE of
   repeated full fits until >= MIN_MEASURE_SECONDS of wall-clock accumulates
   — no reported metric derives from a sub-100ms measurement. Completion is
@@ -453,17 +459,33 @@ def _flush_device_queue(data):
 
 
 def run_variant(task_name):
+    from photon_tpu.data.pipeline import PIPELINE_STATS
+
     data = build_data(task_name)
     est = build_estimator(task_name)
     _flush_device_queue(data)
 
     t0 = time.perf_counter()
     datasets, _ = est.prepare(data)
-    ingest_seconds = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
+    t1 = time.perf_counter()
     _fit_blocking(est, data)
-    compile_seconds = time.perf_counter() - t0
+    t2 = time.perf_counter()
+    ingest_seconds = t1 - t0
+    first_fit_seconds = t2 - t1
+    # MEASURED wall clock of the pipelined prepare + first fit — NOT the
+    # sum of phases. With the overlapped AOT compile, the compile work
+    # runs during ingest, so e2e < ingest + compile whenever the overlap
+    # is real (the round-6 acceptance criterion).
+    e2e_seconds = t2 - t0
+    pipeline_stats = PIPELINE_STATS.report()
+    # compile_seconds reports the full compile cost actually paid: the
+    # background AOT warm compile's duration when it ran (its
+    # non-overlapped remainder shows up inside first_fit_seconds as
+    # compile_wait), else the first fit's wall clock (the legacy serial
+    # meaning — compile dominates a cold first fit).
+    compile_seconds = (
+        pipeline_stats["compile_seconds"] or first_fit_seconds
+    )
 
     # Steady state: aggregate whole fits until the measurement window is
     # long enough that per-fit dispatch jitter is noise.
@@ -499,13 +521,15 @@ def run_variant(task_name):
         cost_model=cost_model,
         ingest_seconds=ingest_seconds,
         compile_seconds=compile_seconds,
+        first_fit_seconds=first_fit_seconds,
+        pipeline=pipeline_stats,
         train_seconds=per_fit,
         measured_fits=fits,
         measure_window_seconds=train_seconds_total,
         rows_per_sec=N_ROWS * CD_ITERATIONS / per_fit,
         model_flops_per_sec=flops / per_fit,
         hbm_bytes_per_sec=hbm / per_fit,
-        e2e_seconds=ingest_seconds + compile_seconds,
+        e2e_seconds=e2e_seconds,
         warm_cache_e2e_seconds=warm_e2e,
     )
 
@@ -750,12 +774,123 @@ def run_wide_d():
     }
 
 
-def main():
+def _variant_fields(name: str, v: dict) -> dict:
+    return {
+        f"{name}_rows_per_sec": round(v["rows_per_sec"], 1),
+        f"{name}_train_seconds": round(v["train_seconds"], 4),
+        f"{name}_measured_fits": v["measured_fits"],
+        f"{name}_measure_window_seconds": round(
+            v["measure_window_seconds"], 3),
+        f"{name}_ingest_seconds": round(v["ingest_seconds"], 3),
+        f"{name}_ingest_rows_per_sec": round(
+            N_ROWS / v["ingest_seconds"], 1),
+        f"{name}_compile_seconds": round(v["compile_seconds"], 3),
+        f"{name}_first_fit_seconds": round(v["first_fit_seconds"], 3),
+        # e2e is the MEASURED wall of prepare + first fit; the ingest
+        # pipeline's per-stage breakdown (plan/pack/transfer/compile +
+        # the measured compile-overlap fraction) rides next to it.
+        f"{name}_e2e_seconds": round(v["e2e_seconds"], 3),
+        f"{name}_plan_seconds": v["pipeline"]["plan_seconds"],
+        f"{name}_transfer_seconds": v["pipeline"]["transfer_seconds"],
+        f"{name}_compile_overlap_fraction": (
+            v["pipeline"]["compile_overlap_fraction"]),
+        f"{name}_pipeline": v["pipeline"],
+        f"{name}_warm_cache_e2e_seconds": round(
+            v["warm_cache_e2e_seconds"], 3),
+        f"{name}_model_flops_per_sec": round(
+            v["model_flops_per_sec"], 1),
+        f"{name}_fraction_of_bf16_peak": round(
+            v["model_flops_per_sec"] / PEAK_BF16_FLOPS, 8),
+        f"{name}_hbm_bytes_per_sec": round(v["hbm_bytes_per_sec"], 1),
+        f"{name}_fraction_of_hbm_peak": round(
+            v["hbm_bytes_per_sec"] / PEAK_HBM_BYTES, 6),
+        # Static cost model (analysis/costmodel.py): per-program
+        # predicted FLOPs/HBM-bytes + roofline bound for the fused
+        # fit and slab materialization programs.
+        f"{name}_cost_model": v["cost_model"],
+    }
+
+
+def _apply_smoke():
+    """Shrink the workload to CI scale (CPU runners, ~a minute).
+
+    The smoke line exists to prove the INGEST PIPELINE machinery end to
+    end — parallel planning, packed transfer, the AOT warm compile and
+    its PIPELINE_STATS accounting — not to measure throughput, so the
+    TPU-scale regression floors do not apply to it.
+    """
+    global N_ROWS, N_USERS, N_MOVIES, MIN_MEASURE_SECONDS
+    N_ROWS = 20_000
+    N_USERS = 500
+    N_MOVIES = 100
+    MIN_MEASURE_SECONDS = 0.2
+
+
+def run_smoke() -> dict:
+    """`bench.py --smoke`: the linear variant at CI scale, one JSON line.
+
+    Asserts (in the output, for the CI job to check) that the pipeline
+    stats were emitted with every per-stage field present."""
+    lin = run_variant("linear")
+    pipe = lin["pipeline"]
+    stats_ok = all(
+        k in pipe
+        for k in (
+            "plan_seconds", "pack_seconds", "transfer_seconds",
+            "compile_seconds", "compile_overlap_fraction",
+        )
+    )
+    # TPU-scale throughput floors don't apply at CI scale; the smoke
+    # regression list checks the PIPELINE itself actually engaged — a
+    # silent fallback to the serial/unfused path would otherwise pass
+    # this job while the feature is dead.
+    regressions = []
+    if not stats_ok:
+        regressions.append("pipeline stats missing per-stage fields")
+    if pipe.get("plan_seconds", 0) <= 0:
+        regressions.append("planner recorded no plan stage")
+    if pipe.get("compile_seconds", 0) <= 0:
+        regressions.append(
+            "AOT warm compile never ran (compile stage empty)")
+    out = {
+        "metric": "glmix_ingest_pipeline_smoke",
+        "smoke": True,
+        "workload": {
+            "rows": N_ROWS, "users": N_USERS, "movies": N_MOVIES,
+            "cd_iterations": CD_ITERATIONS,
+        },
+        "pipeline_stats_ok": bool(stats_ok),
+        "regressions": regressions,
+    }
+    out.update(_variant_fields("linear", lin))
+    return out
+
+
+def main(argv=None):
+    import argparse
+
     from photon_tpu.utils import enable_compilation_cache
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="CI-scale run: linear variant only, pipeline-stats assertion, "
+        "no TPU-scale floors",
+    )
+    args = parser.parse_args(argv)
 
     # Persistent XLA compile cache: cold runs pay compile_seconds once per
     # machine; repeat runs (and re-runs across rounds) hit the disk cache.
     enable_compilation_cache()
+
+    if args.smoke:
+        _apply_smoke()
+        out = run_smoke()
+        from photon_tpu.utils import cache_stats
+
+        out["compile_cache"] = cache_stats()
+        print(json.dumps(out))
+        return
 
     logi = run_variant("logistic")
     lin = run_variant("linear")
@@ -793,31 +928,7 @@ def main():
         "regressions": regressions,
     }
     for name, v in (("logistic", logi), ("linear", lin)):
-        out.update({
-            f"{name}_rows_per_sec": round(v["rows_per_sec"], 1),
-            f"{name}_train_seconds": round(v["train_seconds"], 4),
-            f"{name}_measured_fits": v["measured_fits"],
-            f"{name}_measure_window_seconds": round(
-                v["measure_window_seconds"], 3),
-            f"{name}_ingest_seconds": round(v["ingest_seconds"], 3),
-            f"{name}_ingest_rows_per_sec": round(
-                N_ROWS / v["ingest_seconds"], 1),
-            f"{name}_compile_seconds": round(v["compile_seconds"], 3),
-            f"{name}_e2e_seconds": round(v["e2e_seconds"], 3),
-            f"{name}_warm_cache_e2e_seconds": round(
-                v["warm_cache_e2e_seconds"], 3),
-            f"{name}_model_flops_per_sec": round(
-                v["model_flops_per_sec"], 1),
-            f"{name}_fraction_of_bf16_peak": round(
-                v["model_flops_per_sec"] / PEAK_BF16_FLOPS, 8),
-            f"{name}_hbm_bytes_per_sec": round(v["hbm_bytes_per_sec"], 1),
-            f"{name}_fraction_of_hbm_peak": round(
-                v["hbm_bytes_per_sec"] / PEAK_HBM_BYTES, 6),
-            # Static cost model (analysis/costmodel.py): per-program
-            # predicted FLOPs/HBM-bytes + roofline bound for the fused
-            # fit and slab materialization programs.
-            f"{name}_cost_model": v["cost_model"],
-        })
+        out.update(_variant_fields(name, v))
     out.update(sklearn_anchor)
     out.update(yahoo)
     out.update(a9a)

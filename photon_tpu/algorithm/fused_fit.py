@@ -27,6 +27,7 @@ host-driven). Everything else falls back to ``CoordinateDescent``.
 from __future__ import annotations
 
 import dataclasses
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +65,7 @@ from photon_tpu.models.game import (
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 
 Array = jax.Array
+logger = logging.getLogger(__name__)
 
 # Program contract (audited by `python -m photon_tpu.analysis --semantic`,
 # machinery in analysis/program.py): one fused-fit generation is at most
@@ -356,6 +358,12 @@ class FusedFit:
         # tunneled backend, which would otherwise recur on every fit.
         self._zeros_cache: dict[tuple, Array] = {}
         self.static_key = None  # set by the estimator cache
+        # Ingest pipeline's overlapped AOT compile: the estimator attaches
+        # the background warm-compile future; run() consumes it — the
+        # compiled materialize/fit executables are used directly when the
+        # static key and operand avals match, else the normal jit path.
+        self._aot_future = None
+        self._aot: dict | None = None
 
     # ------------------------------------------------------------------
     # operand assembly (per run; cheap)
@@ -806,6 +814,53 @@ class FusedFit:
         """Lower (never execute) the slab materialization program."""
         return self._mat_jit.lower(self._mat_operands(coords))
 
+    def aot_lower(self, coords) -> dict:
+        """Trace the materialize + cold-fit programs for AOT warm compile.
+
+        The SAME operand assembly as ``trace``/``run`` (the audited
+        ingest-pipeline contract pins that these jaxprs match the
+        production generation's signatures exactly), packaged with the
+        statics so the caller can key the compiled executables."""
+        mat_ops = self._mat_operands(coords)
+        mat_traced = self._mat_jit.trace(mat_ops)
+        ebs_avals = jax.eval_shape(self._mat_fn, mat_ops)
+        ops = self._operands(coords, None)
+        statics = self._statics(coords, None)
+        fit_traced = self._jit.trace(ops, ebs_avals, statics=statics)
+        return {
+            "mat_traced": mat_traced,
+            "fit_traced": fit_traced,
+            "statics": statics,
+        }
+
+    def _consume_aot(self) -> dict | None:
+        """Resolve the pending warm-compile future (blocking if the
+        compile is still running — that block is the measured
+        ``compile_wait`` stage, the non-overlapped remainder) and keep
+        the artifacts when they belong to this static structure."""
+        fut = self._aot_future
+        if fut is not None:
+            from photon_tpu.data.pipeline import PIPELINE_STATS
+
+            self._aot_future = None
+            with PIPELINE_STATS.stage("compile_wait"):
+                art = fut.result()
+            if art is not None and art.get("key") == self.static_key:
+                self._aot = art
+        return self._aot
+
+    def _run_mat(self, coords, aot):
+        """Materialize slabs via the AOT executable when compatible."""
+        mat_ops = self._mat_operands(coords)
+        if aot is not None:
+            try:
+                return aot["mat"](mat_ops)
+            except Exception:  # noqa: BLE001 — stale shape prediction
+                logger.info(
+                    "ingest pipeline: AOT materialize executable "
+                    "incompatible with the built datasets; recompiling")
+        return self._mat_jit(mat_ops)
+
     # ------------------------------------------------------------------
     # the public entry
     # ------------------------------------------------------------------
@@ -817,6 +872,7 @@ class FusedFit:
     ) -> CoordinateDescentResult:
         ops = self._operands(coords, initial_models)
         statics = self._statics(coords, initial_models)
+        aot = self._consume_aot()
         # Slabs materialize once per dataset generation (separate cached
         # program that also unpacks the ingest's packed plan buffer);
         # every fit's program receives the results as plain operands.
@@ -825,14 +881,24 @@ class FusedFit:
         if self._mat_shared is not None:
             ebs_all = self._mat_shared.get("ebs")
             if ebs_all is None:
-                ebs_all = self._mat_shared["ebs"] = self._mat_jit(
-                    self._mat_operands(coords))
+                ebs_all = self._mat_shared["ebs"] = self._run_mat(
+                    coords, aot)
         else:
             if self._mat_cache is None:
-                self._mat_cache = self._mat_jit(self._mat_operands(coords))
+                self._mat_cache = self._run_mat(coords, aot)
             ebs_all = self._mat_cache
-        states, scores, total, packed_flat = self._jit(
-            ops, ebs_all, statics=statics)
+        out = None
+        if aot is not None and statics == aot.get("statics"):
+            try:
+                out = aot["fit"](ops, ebs_all)
+            except Exception:  # noqa: BLE001 — stale shape prediction
+                logger.info(
+                    "ingest pipeline: AOT fit executable incompatible "
+                    "with the built datasets; recompiling")
+                self._aot = None
+        if out is None:
+            out = self._jit(ops, ebs_all, statics=statics)
+        states, scores, total, packed_flat = out
         # Diagnostic shapes, in the exact flattening order of _fit_fn's
         # packing; indices into _PackedDiags per coordinate.
         shapes: list[tuple] = []

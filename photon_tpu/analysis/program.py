@@ -41,6 +41,7 @@ unchanged. Suppressions are PER CONTRACT, declared in the contract's
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import importlib
@@ -78,6 +79,7 @@ SEMANTIC_RULES: dict[str, str] = {
 # data so importing the audited modules stays free of analysis imports.
 DECLARING_MODULES = (
     "photon_tpu.algorithm.fused_fit",
+    "photon_tpu.data.pipeline",
     "photon_tpu.estimators.game_estimator",
     "photon_tpu.ops.newton_kernel",
     "photon_tpu.parallel.mesh",
@@ -871,6 +873,68 @@ def build_mesh_sharding() -> ContractTrace:
     )
 
 
+def build_ingest_pipeline() -> ContractTrace:
+    """The ingest pipeline's overlapped AOT warm-compile entry.
+
+    Two properties, both checked against the PRODUCTION fused generation:
+
+    - **census unchanged**: the programs the background warm compile
+      traces from shape-PREDICTED skeleton datasets
+      (``GameEstimator._warm_compile`` over
+      ``skeleton_random_effect_dataset``) must have EXACTLY the
+      signatures of the production materialize/fit programs — the warm
+      compile mints zero new executables, it pre-pays existing ones. A
+      drifted skeleton (wrong predicted bucket shapes, wrong statics)
+      shows up as extra programs in the census and as an
+      ``aot_warm_compile`` stability violation.
+    - **no host sync in the overlap window**: the traced fit jaxpr (which
+      signature-equality proves is also the warm-compiled one) carries no
+      callback primitive (``hot_loop`` host-boundary check).
+
+    Runs with ``PHOTON_TPU_SERIAL_INGEST=1`` so the build itself is
+    deterministic and the warm compile is invoked synchronously.
+    """
+    with _serial_ingest_env():
+        est, data = _tiny_glmix()
+        datasets, _ = est.prepare(data)
+        coords = est._build_coordinates(
+            datasets, {}, {}, data.num_samples
+        )
+        fused = est._fused_for(coords, datasets)
+        mat = trace_program(
+            "materialize", fused._mat_jit, fused._mat_operands(coords)
+        )
+        traced = fused.trace(coords)
+        fit = TracedProgram(
+            name="fit",
+            text=str(traced.jaxpr),
+            jaxpr=traced.jaxpr,
+            lowered=traced.lower(),
+        )
+        art = est._warm_compile(data)
+    variants: dict[str, list[dict[str, str]]] = {"aot_warm_compile": []}
+    notes = []
+    if art is not None:
+        variants["aot_warm_compile"].append({
+            "materialize": TracedProgram(
+                "materialize", art["mat_text"]).signature,
+            "fit": TracedProgram("fit", art["fit_text"]).signature,
+        })
+        notes.append(
+            "warm compile traced from predicted shapes; signature "
+            "equality with the production programs proves the compiled "
+            "executables are the ones the first fit dispatches"
+        )
+    # else: the empty declared-stable family trips the program-contract
+    # integrity finding — prediction silently declining on the canonical
+    # fixture is a contract violation, not a skip.
+    return ContractTrace(
+        programs={"materialize": mat, "fit": fit},
+        variants=variants,
+        notes=notes,
+    )
+
+
 def build_evaluators() -> ContractTrace:
     """Evaluation + scoring entry points: shape-specialized (a row-count
     change recompiles, by design), value-stable, no host callbacks."""
@@ -916,6 +980,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_unfused_update": build_unfused_update,
     "build_newton_kernel": build_newton_kernel,
     "build_mesh_sharding": build_mesh_sharding,
+    "build_ingest_pipeline": build_ingest_pipeline,
     "build_evaluators": build_evaluators,
 }
 
@@ -977,6 +1042,19 @@ def collect_contracts() -> list[ProgramContract]:
 # --------------------------------------------------------------------------
 
 
+@contextlib.contextmanager
+def _serial_ingest_env():
+    saved = os.environ.get("PHOTON_TPU_SERIAL_INGEST")
+    os.environ["PHOTON_TPU_SERIAL_INGEST"] = "1"
+    try:
+        yield
+    finally:
+        if saved is None:
+            os.environ.pop("PHOTON_TPU_SERIAL_INGEST", None)
+        else:
+            os.environ["PHOTON_TPU_SERIAL_INGEST"] = saved
+
+
 def _ensure_virtual_devices() -> None:
     """Give the sharding audit a multi-device CPU platform when possible.
 
@@ -1012,7 +1090,11 @@ def audit(
         chip = costmodel.DEFAULT_CHIP
     findings: list[Finding] = []
     report: dict[str, Any] = {"contracts": {}}
-    with disable_x64():
+    # Serial ingest for the whole audit: contract builds must be
+    # deterministic, and the estimator fixtures would otherwise spawn
+    # background warm compiles nobody consumes (the ingest-pipeline
+    # contract invokes the warm compile explicitly, synchronously).
+    with disable_x64(), _serial_ingest_env():
         resolved = (
             collect_contracts() if contracts is None else list(contracts)
         )

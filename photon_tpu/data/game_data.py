@@ -207,7 +207,20 @@ def make_game_dataset(
     # copy is pushed exactly once, here). Device-backed shards pass through
     # untouched (no mirror; host views fall back to a one-time pull).
     # jax.device_put moves large host buffers ~2x faster than jnp.asarray
-    # (no trace/convert layer), and the column pushes batch into one call.
+    # (no trace/convert layer), and EVERY push — all shards' arrays plus
+    # the three columns — batches into ONE device_put call, enqueued
+    # asynchronously so the ingest planner starts on the host mirrors
+    # while the raw data is still crossing the link (the transfer time is
+    # accounted in PIPELINE_STATS as "raw_transfer").
+    from photon_tpu.data.pipeline import PIPELINE_STATS
+
+    staged: list[np.ndarray] = []
+
+    def stage_arr(arr: np.ndarray) -> int:
+        staged.append(arr)
+        return len(staged) - 1
+
+    specs: dict[str, tuple] = {}
     shards: dict[str, Features] = {}
     for name, feats in feature_shards.items():
         rows = (feats.x.shape[0] if hasattr(feats, "x") else feats.indices.shape[0])
@@ -220,22 +233,31 @@ def make_game_dataset(
             host[("shard", name)] = (
                 np.broadcast_to(np.arange(d, dtype=np.int32), x.shape), x, d,
             )
-            feats = DenseFeatures(jax.device_put(x))
+            specs[name] = ("dense", stage_arr(x))
         elif isinstance(feats, SparseFeatures) and isinstance(
             feats.indices, np.ndarray
         ):
             idx = np.asarray(feats.indices, dtype=np.int32)
             val = np.asarray(feats.values, dtype=np_dtype)
             host[("shard", name)] = (idx, val, feats.d)
-            feats = SparseFeatures(
-                jax.device_put(idx), jax.device_put(val), feats.d
-            )
+            specs[name] = ("sparse", stage_arr(idx), stage_arr(val), feats.d)
         shards[name] = feats
-    cols = jax.device_put([labels_np, offsets_np, weights_np])
+    i_lab = stage_arr(labels_np)
+    i_off = stage_arr(offsets_np)
+    i_wt = stage_arr(weights_np)
+    with PIPELINE_STATS.stage("raw_transfer"):
+        devs = jax.device_put(staged)
+    for name, spec in specs.items():
+        if spec[0] == "dense":
+            shards[name] = DenseFeatures(devs[spec[1]])
+        else:
+            shards[name] = SparseFeatures(
+                devs[spec[1]], devs[spec[2]], spec[3]
+            )
     return GameDataset(
-        labels=cols[0],
-        offsets=cols[1],
-        weights=cols[2],
+        labels=devs[i_lab],
+        offsets=devs[i_off],
+        weights=devs[i_wt],
         feature_shards=shards,
         id_tags={k: IdTag.from_raw(v) for k, v in (id_tags or {}).items()},
         uids=None if uids is None else np.asarray(uids),

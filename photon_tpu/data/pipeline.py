@@ -1,0 +1,432 @@
+"""Pipelined ingest: executors, stage accounting, and chunked transfer.
+
+The reference's ingest is a cluster-wide shuffle pipeline
+(RandomEffectDataset.scala's groupBy/foldByKey); ours is a host-side numpy
+planning pass feeding one packed device transfer and one AOT compile. Run
+serially those three phases ADD (bench round 5: ``e2e_seconds =
+ingest_seconds + compile_seconds``, and the planner fell below the 1M
+rows/s ingest floor). This module owns the machinery that overlaps them:
+
+- **Planning executors** (``plan_executor`` / ``chunk_executor``): the
+  per-coordinate planning passes run concurrently (the hot numpy ops —
+  radix argsort, bincount, fancy gathers — release the GIL), and
+  within-coordinate elementwise passes chunk over rows
+  (``map_chunked`` / ``bincount_chunked`` — exact, order-preserving, so
+  results are BIT-IDENTICAL to the serial path; the deterministic
+  reservoir hash order is the contract). Two separate pools: coordinate
+  tasks block on their own chunk tasks, so running both levels on one
+  bounded pool could deadlock (all workers waiting on queued chunks).
+- **Chunked double-buffered transfer** (``packed_device_put``): the single
+  packed plan buffer is pushed as granule-aligned chunks with each
+  ``jax.device_put`` enqueued ASYNCHRONOUSLY while the host fills the
+  next chunk's staging buffer, then fused into the one contiguous buffer
+  by a donated in-trace concatenate (the chunk buffers' HBM is donated,
+  so peak device memory stays ~1x). Small builds (below one chunk) take
+  the legacy single-shot path — byte-identical layout either way.
+- **PIPELINE_STATS**: per-stage seconds (plan / pack / transfer /
+  compile / compile_wait) + the measured compile-overlap fraction, reset
+  per prepare and reported by ``bench.py``.
+
+``PHOTON_TPU_SERIAL_INGEST=1`` forces everything back to the serial
+in-line path (the determinism property tests diff the two);
+``PHOTON_TPU_INGEST_THREADS`` bounds the chunk pool (CI uses 2).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+
+# Program contract (audited by `python -m photon_tpu.analysis --semantic`;
+# machinery in analysis/program.py): the ingest pipeline's AOT warm-compile
+# entry must trace EXACTLY the programs the production fused fit runs — the
+# skeleton-predicted materialize/fit jaxprs match the real generation's
+# signatures (dispatch census unchanged: warm compile adds ZERO programs),
+# and the overlap window introduces no host callback into either jaxpr.
+PROGRAM_AUDIT = dict(
+    name="ingest-pipeline",
+    entry="data.pipeline + estimators.game_estimator._warm_compile "
+    "(AOT warm compile from predicted shapes)",
+    builder="build_ingest_pipeline",
+    max_programs=2,
+    stable_under=("aot_warm_compile",),
+    hot_loop=True,
+)
+
+
+def serial_ingest() -> bool:
+    """True when the serial reference path is forced (env contract)."""
+    return os.environ.get("PHOTON_TPU_SERIAL_INGEST", "") == "1"
+
+
+def ingest_threads() -> int:
+    raw = os.environ.get("PHOTON_TPU_INGEST_THREADS", "")
+    if raw.isdigit() and int(raw) > 0:
+        return int(raw)
+    return min(8, os.cpu_count() or 1)
+
+
+# Minimum rows before an elementwise pass is worth chunking across
+# threads: below this the submit/join overhead exceeds the work.
+_CHUNK_MIN_ROWS = 1 << 19
+_TRANSFER_GRANULE_ELEMS = (4 << 20) // 4  # 4 MiB of int32 elements
+
+
+def transfer_chunk_elems() -> int:
+    """Transfer chunk size in int32 elements (PHOTON_TPU_TRANSFER_CHUNK_MB,
+    default 64 MiB), rounded up to the packed buffer's 4 MiB granule so
+    every chunk but the last has one recurring transfer shape."""
+    raw = os.environ.get("PHOTON_TPU_TRANSFER_CHUNK_MB", "")
+    mb = int(raw) if raw.isdigit() and int(raw) > 0 else 64
+    elems = (mb << 20) // 4
+    g = _TRANSFER_GRANULE_ELEMS
+    return max(-(-elems // g) * g, g)
+
+
+class _Immediate(Future):
+    """Already-resolved future for the serial in-line path."""
+
+    def __init__(self, result=None, exc=None):
+        super().__init__()
+        if exc is not None:
+            self.set_exception(exc)
+        else:
+            self.set_result(result)
+
+
+class _Pool:
+    """Lazy thread pool that degrades to in-line execution when serial
+    ingest is forced (or only one worker would exist)."""
+
+    def __init__(self, name: str, workers):
+        self._name = name
+        self._workers = workers  # int or callable () -> int
+        self._pool: ThreadPoolExecutor | None = None
+        self._lock = threading.Lock()
+
+    def _resolve_workers(self) -> int:
+        w = self._workers
+        return w() if callable(w) else w
+
+    def submit(self, fn, *args, **kwargs) -> Future:
+        if serial_ingest() or self._resolve_workers() <= 1:
+            try:
+                return _Immediate(fn(*args, **kwargs))
+            except Exception as exc:  # noqa: BLE001 — parity with Future
+                return _Immediate(exc=exc)
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._resolve_workers(),
+                    thread_name_prefix=self._name,
+                )
+        return self._pool.submit(fn, *args, **kwargs)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+
+# Coordinate-level planning tasks (each may block on its own chunk tasks,
+# hence the separate pool) and one background slot for the AOT warm
+# compile (XLA compiles in C++ with the GIL released).
+plan_executor = _Pool("photon-plan", 4)
+chunk_executor = _Pool("photon-chunk", ingest_threads)
+compile_executor = _Pool("photon-compile", 2)
+
+
+def reset_executors() -> None:
+    """Drop pools so the next use re-reads the env (tests)."""
+    plan_executor.shutdown()
+    chunk_executor.shutdown()
+    compile_executor.shutdown()
+
+
+class PipelineStats:
+    """Thread-safe per-stage wall-clock accounting for one ingest.
+
+    Stage seconds ACCUMULATE (two coordinates planning concurrently both
+    add their thread-local seconds — the report also keeps the wall span
+    per stage, which is what overlap claims are judged on).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._generation = 0
+        self.reset()
+
+    def reset(self, keep: tuple = ()) -> None:
+        """Start a new accounting generation.
+
+        Stages entered BEFORE the reset record nothing when they finish
+        (the generation token they captured is stale) — an orphaned
+        background compile from a previous dataset generation must not
+        write its seconds into the new generation's report. ``keep``
+        names stages whose accumulation survives the reset (the raw-data
+        transfer recorded at ``make_game_dataset`` time, which happens
+        before any estimator exists).
+        """
+        with self._lock:
+            kept_s = {
+                k: v
+                for k, v in getattr(self, "_seconds", {}).items()
+                if k in keep
+            }
+            kept_sp = {
+                k: v
+                for k, v in getattr(self, "_spans", {}).items()
+                if k in keep
+            }
+            kept_c = {
+                k: v
+                for k, v in getattr(self, "_counts", {}).items()
+                if k in keep
+            }
+            self._generation += 1
+            self._seconds: dict[str, float] = kept_s
+            self._spans: dict[str, list[float]] = kept_sp
+            self._counts: dict[str, int] = kept_c
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        with self._lock:
+            gen = self._generation
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            t1 = time.perf_counter()
+            with self._lock:
+                # A stale generation token (reset() ran mid-stage, e.g.
+                # an orphaned background compile) records nothing — it
+                # must not pollute the new generation's report.
+                if gen == self._generation:
+                    self._seconds[name] = self._seconds.get(
+                        name, 0.0
+                    ) + (t1 - t0)
+                    self._counts[name] = self._counts.get(name, 0) + 1
+                    span = self._spans.get(name)
+                    if span is None:
+                        self._spans[name] = [t0, t1]
+                    else:
+                        span[0] = min(span[0], t0)
+                        span[1] = max(span[1], t1)
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._seconds[name] = self._seconds.get(name, 0.0) + seconds
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def seconds(self, name: str) -> float:
+        with self._lock:
+            return self._seconds.get(name, 0.0)
+
+    def report(self) -> dict:
+        """The JSON-ready stage breakdown ``bench.py`` embeds.
+
+        ``compile_overlap_fraction`` is measured, not inferred: the AOT
+        warm compile's duration minus the time the first fit actually
+        BLOCKED waiting for it, over the duration — 1.0 means the compile
+        hid entirely under ingest + operand assembly, 0.0 means it was
+        paid serially after all (and None means no warm compile ran)."""
+        with self._lock:
+            seconds = dict(self._seconds)
+            spans = {k: tuple(v) for k, v in self._spans.items()}
+        compile_s = seconds.get("compile", 0.0)
+        wait_s = seconds.get("compile_wait", 0.0)
+        overlap = (
+            max(0.0, min(1.0, 1.0 - wait_s / compile_s))
+            if compile_s > 0.0
+            else None
+        )
+        out = {
+            "plan_seconds": round(seconds.get("plan", 0.0), 4),
+            "pack_seconds": round(seconds.get("pack", 0.0), 4),
+            "transfer_seconds": round(seconds.get("transfer", 0.0), 4),
+            "compile_seconds": round(compile_s, 4),
+            "compile_wait_seconds": round(wait_s, 4),
+            "compile_overlap_fraction": (
+                None if overlap is None else round(overlap, 4)
+            ),
+            "stages": {k: round(v, 4) for k, v in sorted(seconds.items())},
+        }
+        plan_span = spans.get("plan")
+        if plan_span is not None:
+            out["plan_wall_seconds"] = round(
+                plan_span[1] - plan_span[0], 4
+            )
+        return out
+
+
+PIPELINE_STATS = PipelineStats()
+
+
+# --------------------------------------------------------------------------
+# chunked host passes (bit-identical to the serial forms)
+# --------------------------------------------------------------------------
+
+
+def _chunk_bounds(n: int, workers: int) -> list[tuple[int, int]]:
+    per = -(-n // workers)
+    return [(lo, min(lo + per, n)) for lo in range(0, n, per)]
+
+
+def map_chunked(fn, out: np.ndarray, *arrays: np.ndarray) -> np.ndarray:
+    """``out[lo:hi] = fn(*[a[lo:hi] for a in arrays])`` over row chunks.
+
+    For ELEMENTWISE ``fn`` only (each output row depends on the same row
+    of the inputs): chunking is then exact, so the parallel result is
+    byte-identical to ``out[:] = fn(*arrays)``. Serial mode (or small
+    inputs) takes the one-shot path.
+    """
+    n = out.shape[0]
+    workers = ingest_threads()
+    if serial_ingest() or workers <= 1 or n < _CHUNK_MIN_ROWS:
+        out[:] = fn(*arrays)
+        return out
+
+    def run(lo: int, hi: int) -> None:
+        out[lo:hi] = fn(*[a[lo:hi] for a in arrays])
+
+    futs = [
+        chunk_executor.submit(run, lo, hi)
+        for lo, hi in _chunk_bounds(n, workers)
+    ]
+    for f in futs:
+        f.result()
+    return out
+
+
+def bincount_chunked(codes: np.ndarray, minlength: int) -> np.ndarray:
+    """Exact parallel ``np.bincount`` (partial integer counts sum
+    associatively, so the chunked result is identical)."""
+    n = codes.shape[0]
+    workers = ingest_threads()
+    if serial_ingest() or workers <= 1 or n < _CHUNK_MIN_ROWS:
+        return np.bincount(codes, minlength=minlength)
+    futs = [
+        chunk_executor.submit(
+            np.bincount, codes[lo:hi], minlength=minlength
+        )
+        for lo, hi in _chunk_bounds(n, workers)
+    ]
+    total = futs[0].result().astype(np.int64, copy=True)
+    for f in futs[1:]:
+        total += f.result()
+    return total
+
+
+# --------------------------------------------------------------------------
+# chunked double-buffered packed transfer
+# --------------------------------------------------------------------------
+
+
+def padded_len(n: int) -> int:
+    """Packed-buffer length after granule padding — THE pad rule shared
+    by the real transfer and the shape oracle's predicted layout."""
+    g = _TRANSFER_GRANULE_ELEMS
+    return max(-(-n // g) * g, g)
+
+
+def _packed_len(arrays) -> tuple[int, int]:
+    n = sum(int(np.prod(a.shape)) if a.shape else 1 for a in arrays)
+    return n, padded_len(n)
+
+
+def _fill_chunks(arrays, n_pad: int, chunk_elems: int):
+    """Yield freshly allocated int32 staging buffers covering the packed
+    layout [0, n_pad) in order. Fresh per chunk: ``jax.device_put`` may
+    read the source asynchronously, so staging buffers are never reused
+    while a transfer could still be draining (the double-buffering
+    contract)."""
+    remaining = n_pad
+    chunk = np.zeros(min(chunk_elems, remaining), dtype=np.int32)
+    filled = 0
+    for a in arrays:
+        flat = np.ascontiguousarray(a, dtype=np.int32).reshape(-1)
+        o = 0
+        while o < flat.size:
+            take = min(flat.size - o, chunk.size - filled)
+            chunk[filled:filled + take] = flat[o:o + take]
+            filled += take
+            o += take
+            if filled == chunk.size:
+                yield chunk
+                remaining -= chunk.size
+                chunk = np.zeros(
+                    min(chunk_elems, remaining), dtype=np.int32
+                )
+                filled = 0
+    while remaining > 0:  # zero padding tail (buffers start zeroed)
+        yield chunk
+        remaining -= chunk.size
+        chunk = np.zeros(min(chunk_elems, remaining), dtype=np.int32)
+
+
+_concat_cache: dict[int, object] = {}
+
+
+def _concat_chunks(chunks: tuple):
+    """Donated in-trace concatenate: one program per chunk COUNT (chunk
+    sizes recur — all equal but the last — so similarly sized ingests
+    share the executable), with the chunk buffers' device memory donated
+    into the output."""
+    import jax
+
+    fn = _concat_cache.get(len(chunks))
+    if fn is None:
+        import jax.numpy as jnp
+
+        # Donation frees the chunk buffers' HBM into the output on
+        # accelerators; the CPU backend would warn on every call.
+        donate = (
+            (0,) if jax.default_backend() not in ("cpu",) else ()
+        )
+        fn = jax.jit(
+            lambda cs: jnp.concatenate(cs), donate_argnums=donate
+        )
+        _concat_cache[len(chunks)] = fn
+    return fn(tuple(chunks))
+
+
+def packed_device_put(arrays) -> tuple:
+    """Place the packed int32 plan layout on device; returns (buf, shapes).
+
+    Below one chunk this is the legacy single-shot path (one staging fill,
+    one ``device_put``). Above it, granule-aligned chunks stream out with
+    the host filling chunk i+1 while chunk i's transfer drains, and a
+    donated concatenate restores the ONE contiguous buffer every packed
+    consumer slices at static offsets (the layout contract is unchanged —
+    byte-identical to the single-shot buffer).
+    """
+    import jax
+
+    shapes = tuple(a.shape for a in arrays)
+    n, n_pad = _packed_len(arrays)
+    chunk_elems = transfer_chunk_elems()
+    if serial_ingest() or n_pad <= chunk_elems:
+        with PIPELINE_STATS.stage("pack"):
+            flat = np.empty(n_pad, dtype=np.int32)
+            o = 0
+            for a in arrays:
+                flat[o:o + a.size] = np.ascontiguousarray(
+                    a, dtype=np.int32
+                ).reshape(-1)
+                o += a.size
+            flat[o:] = 0
+        with PIPELINE_STATS.stage("transfer"):
+            buf = jax.device_put(flat)
+        return buf, shapes
+    parts = []
+    with PIPELINE_STATS.stage("transfer"):
+        for chunk in _fill_chunks(arrays, n_pad, chunk_elems):
+            parts.append(jax.device_put(chunk))
+        buf = _concat_chunks(tuple(parts))
+    return buf, shapes

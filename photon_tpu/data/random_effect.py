@@ -58,6 +58,13 @@ from photon_tpu.data.dataset import (
     SparseFeatures,
 )
 from photon_tpu.data.game_data import GameDataset
+from photon_tpu.data.pipeline import (
+    PIPELINE_STATS,
+    bincount_chunked,
+    chunk_executor,
+    map_chunked,
+    packed_device_put,
+)
 
 Array = jax.Array
 
@@ -726,6 +733,9 @@ class _Plan:
 
     codes: np.ndarray  # [n] int64 owning-entity code per row
     perm: np.ndarray  # [n] rows sorted by (entity, reservoir hash)
+    sorted_codes: np.ndarray  # [n] codes[perm] (computed once; hoisted
+    # out of the per-bucket row selection, which used to re-gather it
+    # per bucket — the round-5 ingest-floor bisect's actual culprit)
     starts: np.ndarray  # [E]
     counts_full: np.ndarray  # [E] rows per entity
     counts: np.ndarray  # [E] kept (reservoir-capped) rows per entity
@@ -765,7 +775,11 @@ def _plan_random_effect(
 
     # --- 1. deterministic reservoir cap: per entity keep the
     # active_data_upper_bound rows with smallest hash keys -----------------
-    counts_full = np.bincount(codes, minlength=num_entities).astype(np.int64)
+    # Chunked passes (bincount partial sums, elementwise hash mixing) are
+    # EXACT: the parallel planner's output is bit-identical to serial.
+    counts_full = bincount_chunked(codes, num_entities).astype(
+        np.int64, copy=False
+    )
     upper = config.active_data_upper_bound
     lower = config.active_data_lower_bound
     cap_binds = upper is not None and bool(
@@ -773,7 +787,11 @@ def _plan_random_effect(
     )
     if cap_binds:
         seed = _stable_type_seed(config.random_effect_type)
-        order_keys = _byteswap64_mix(uids, seed)
+        order_keys = map_chunked(
+            lambda u: _byteswap64_mix(u, seed),
+            np.empty(n, dtype=np.uint64),
+            uids,
+        )
         # Group-by-entity, ordered by hash within the group. A two-key
         # lexsort costs two comparison sorts (~1.5s at 4M rows — the
         # single hottest planning op); packing (code, high hash bits) into
@@ -785,9 +803,13 @@ def _plan_random_effect(
         code_bits = max(int(num_entities - 1).bit_length(), 1)
         if code_bits <= 40:
             hash_bits = 63 - code_bits
-            key = (codes << hash_bits) | (
-                order_keys.astype(np.uint64) >> np.uint64(64 - hash_bits)
-            ).astype(np.int64)
+            key = map_chunked(
+                lambda c, k: (c << hash_bits) | (
+                    k >> np.uint64(64 - hash_bits)
+                ).astype(np.int64),
+                np.empty(n, dtype=np.int64),
+                codes, order_keys,
+            )
             perm = np.argsort(key, kind="stable")
         else:  # pathological entity counts: keep the exact two-key sort
             perm = np.lexsort((order_keys, codes))
@@ -964,27 +986,11 @@ def _plan_random_effect(
         intercept_slots_all = np.full(num_entities, -1, dtype=np.int32)
 
     # --- 3. size-bucket membership ----------------------------------------
-    caps = np.asarray(sorted(config.bucket_caps), dtype=np.int64)
-    active_ids = np.nonzero(active)[0]
-    r = counts[active_ids]
-    pos = np.searchsorted(caps, r)
-    # Entities above the largest cap round up to the next power of two so
-    # heavy-tailed size distributions share padded shapes (and jit compiles
-    # of the solver) instead of one shape per distinct size.
-    pow2 = np.left_shift(
-        np.int64(1),
-        np.ceil(np.log2(np.maximum(r, 1).astype(np.float64))).astype(
-            np.int64
-        ),
-    )
-    cap_of = np.where(pos < caps.size, caps[np.minimum(pos, caps.size - 1)],
-                      pow2)
-    bucket_members = {
-        int(c): active_ids[cap_of == c] for c in np.unique(cap_of)
-    }
+    bucket_members = _assign_buckets(counts, active, config.bucket_caps)
     return _Plan(
         codes=codes,
         perm=perm,
+        sorted_codes=sorted_codes,
         starts=starts,
         counts_full=counts_full,
         counts=counts,
@@ -999,6 +1005,32 @@ def _plan_random_effect(
         bucket_members=bucket_members,
         num_features=num_features,
     )
+
+
+def _assign_buckets(
+    counts: np.ndarray, active: np.ndarray, bucket_caps: tuple
+) -> dict:
+    """cap -> member entity codes (ascending), shared between the planner
+    and the ingest pipeline's shape oracle (``predict_plan_shapes``) so
+    predicted block shapes can never drift from the built ones."""
+    caps = np.asarray(sorted(bucket_caps), dtype=np.int64)
+    active_ids = np.nonzero(active)[0]
+    r = counts[active_ids]
+    pos = np.searchsorted(caps, r)
+    # Entities above the largest cap round up to the next power of two so
+    # heavy-tailed size distributions share padded shapes (and jit compiles
+    # of the solver) instead of one shape per distinct size.
+    pow2 = np.left_shift(
+        np.int64(1),
+        np.ceil(np.log2(np.maximum(r, 1).astype(np.float64))).astype(
+            np.int64
+        ),
+    )
+    cap_of = np.where(pos < caps.size, caps[np.minimum(pos, caps.size - 1)],
+                      pow2)
+    return {
+        int(c): active_ids[cap_of == c] for c in np.unique(cap_of)
+    }
 
 
 def _split_packed_impl(buf, shapes):
@@ -1129,26 +1161,21 @@ class _ListPlanArrays:
 
 
 def _plan_arrays_to_device(arrays: list[np.ndarray]):
-    """Stage host plan arrays for device use: ONE packed transfer.
+    """Stage host plan arrays for device use: ONE packed buffer.
 
     Returns a PackedPlanArrays (or a _ListPlanArrays fallback when dtypes
-    are mixed). Device placement of the packed buffer happens here — a
-    single granule-padded shape whose transfer path recurs across
-    similarly-sized datasets; per-array splits are deferred to consumers.
+    are mixed). Device placement goes through the ingest pipeline's
+    chunked double-buffered transfer (``pipeline.packed_device_put``):
+    below one chunk it is the legacy single staging fill + one
+    ``device_put``; above it, granule-aligned chunks stream out
+    asynchronously while the host fills the next chunk, and a donated
+    in-trace concatenate restores the one contiguous buffer — the packed
+    layout contract (``static_slices``) is byte-identical either way.
     """
     if any(a.dtype != np.int32 for a in arrays):
         return _ListPlanArrays(arrays)
-    shapes = tuple(a.shape for a in arrays)
-    n = sum(a.size for a in arrays)
-    granule = (4 << 20) // 4  # 4 MiB of int32 elements
-    n_pad = max(-(-n // granule) * granule, granule)
-    flat = np.empty(n_pad, dtype=np.int32)
-    o = 0
-    for a in arrays:
-        flat[o:o + a.size] = a.ravel()
-        o += a.size
-    flat[o:] = 0
-    return PackedPlanArrays(jax.device_put(flat), shapes)
+    buf, shapes = packed_device_put(arrays)
+    return PackedPlanArrays(buf, shapes)
 
 
 def _bucket_rows(plan: _Plan, members: np.ndarray, cap: int):
@@ -1157,18 +1184,29 @@ def _bucket_rows(plan: _Plan, members: np.ndarray, cap: int):
     ``rows_flat`` are the kept canonical rows of all member entities,
     grouped by entity (reservoir hash order within); ``t_of``/``r_of`` are
     their (bucket slot, within-entity rank) coordinates.
+
+    Pure span arithmetic over the sorted order: each member entity's kept
+    rows are exactly the FIRST ``counts[e]`` positions of its sorted span
+    (the reservoir keeps the ``upper`` smallest hash keys, which the
+    planner's sort puts first), so the selection is O(member rows). The
+    previous form re-gathered ``codes[perm]`` and boolean-scanned the
+    FULL row table once PER BUCKET — O(n x buckets) host passes that the
+    round-5 ingest-floor bisect identified as the planner's real
+    regression (the suspected ``cache_stats()`` dir scan never runs in
+    the prepare path). Output is bit-identical (pinned by
+    tests/test_ingest_pipeline.py against the full-scan reference).
     """
-    is_member = np.zeros(plan.active.shape[0] + 1, dtype=bool)
-    is_member[members] = True
-    sorted_codes = plan.codes[plan.perm]
-    sel = plan.keep_sorted & is_member[sorted_codes]
-    rows_flat = plan.perm[sel]
-    owner = sorted_codes[sel]
-    member_rank = np.zeros(plan.active.shape[0], dtype=np.int64)
-    member_rank[members] = np.arange(members.size)
-    t_of = member_rank[owner]
-    r_of = plan.rank_sorted[sel]
-    return rows_flat, t_of, r_of, plan.counts[members]
+    m_starts = plan.starts[members]
+    m_counts = plan.counts[members]
+    total = int(m_counts.sum())
+    if total == 0:
+        empty = np.empty(0, dtype=np.int64)
+        return empty, empty.copy(), empty.copy(), m_counts
+    t_of = np.repeat(np.arange(members.size, dtype=np.int64), m_counts)
+    span_base = np.cumsum(m_counts) - m_counts
+    r_of = np.arange(total, dtype=np.int64) - span_base[t_of]
+    rows_flat = plan.perm[m_starts[t_of] + r_of]
+    return rows_flat, t_of, r_of, m_counts
 
 
 def _score_table_arrays(
@@ -1350,6 +1388,145 @@ class PendingRandomEffectDataset:
     finalize: object  # Callable[[list], RandomEffectDataset]
 
 
+def predict_plan_shapes(
+    game_data: GameDataset,
+    config: RandomEffectDataConfiguration,
+) -> dict | None:
+    """Predict every padded block shape of a build from configs + entity
+    counts alone — the ingest pipeline's shape oracle.
+
+    The full planner needs the expensive sorted passes; the SHAPES need
+    only the per-entity row counts (one chunked bincount) plus the dense
+    shard width: a fully dense shard's active entities all span the whole
+    feature set, so every bucket's projector width is ``d``. That lets the
+    estimator kick off the fused-fit AOT compile while planning is still
+    running. Returns None when shapes can't be predicted without planning
+    (sparse shards, Pearson filtering, width caps, wide subspaces) — and a
+    WRONG prediction (a dense shard with exact zeros) only wastes the
+    background compile: the real fit falls back to the normal jit path,
+    never to wrong results.
+    """
+    feats = game_data.feature_shards.get(config.feature_shard_id)
+    if not isinstance(feats, DenseFeatures):
+        return None
+    if config.features_to_samples_ratio is not None:
+        return None
+    if config.score_table_width_cap is not None:
+        return None
+    d = int(feats.x.shape[1])
+    if d > DENSE_SUB_DIM_MAX:
+        return None  # auto-lazy would refuse; the fused path needs lazy
+    tag = game_data.id_tags[config.random_effect_type]
+    codes = tag.host_codes()
+    num_entities = tag.num_groups
+    n = int(codes.shape[0])
+    counts_full = bincount_chunked(codes, num_entities).astype(
+        np.int64, copy=False
+    )
+    upper = config.active_data_upper_bound
+    lower = config.active_data_lower_bound
+    counts = (
+        counts_full if upper is None else np.minimum(counts_full, upper)
+    )
+    active = counts >= (lower or 1)
+    bucket_members = _assign_buckets(counts, active, config.bucket_caps)
+    any_active = bool(active.any())
+    max_sub_dim = d if any_active else 1
+    buckets = [
+        (cap, int(bucket_members[cap].size), d)
+        for cap in sorted(bucket_members)
+    ]
+    shapes: list[tuple] = []
+    for cap, b, s in buckets:
+        shapes += [(b,), (b, cap), (b,), (b, s), (b,)]
+    shapes.append((num_entities, max_sub_dim))  # projector table
+    shapes.append((n,))  # inverse score map
+    kept_total = int(counts[active].sum())
+    return dict(
+        num_entities=num_entities,
+        num_rows=n,
+        num_features=d,
+        max_sub_dim=max_sub_dim,
+        buckets=buckets,
+        packed_shapes=tuple(shapes),
+        kept_total=kept_total,
+    )
+
+
+def skeleton_random_effect_dataset(
+    game_data: GameDataset,
+    config: RandomEffectDataConfiguration,
+) -> RandomEffectDataset | None:
+    """A shape-faithful stand-in for one coordinate's lazy dataset.
+
+    Plan leaves are zero host arrays at the PREDICTED shapes; the raw
+    feature / label / offset / weight leaves are the REAL device arrays
+    (already resident from ``make_game_dataset``), and the packed view
+    carries a ``ShapeDtypeStruct`` buffer — enough for ``FusedFit`` to
+    trace, lower, and AOT-compile the exact production programs while the
+    real planner is still running. Never used to train: only the compiled
+    executables (keyed by the fused static key + operand avals) survive.
+    """
+    import jax as _jax
+
+    from photon_tpu.data.pipeline import padded_len
+
+    pred = predict_plan_shapes(game_data, config)
+    if pred is None:
+        return None
+    tag = game_data.id_tags[config.random_effect_type]
+    feats = game_data.feature_shards[config.feature_shard_id]
+    e = pred["num_entities"]
+    n = pred["num_rows"]
+    s_all = pred["max_sub_dim"]
+    blocks = []
+    for cap, b, s in pred["buckets"]:
+        blocks.append(BlockPlan(
+            entity_codes=np.zeros(b, np.int32),
+            row_ids=np.zeros((b, cap), np.int32),
+            row_counts=np.zeros(b, np.int32),
+            proj=np.zeros((b, s), np.int32),
+            intercept_slots=np.zeros(b, np.int32),
+            raw=feats,
+            raw_labels=game_data.labels,
+            raw_offsets=game_data.offsets,
+            raw_weights=game_data.weights,
+        ))
+    total = sum(
+        int(np.prod(sh)) if sh else 1 for sh in pred["packed_shapes"]
+    )
+    n_pad = padded_len(total)
+    packed = PackedPlanArrays(
+        _jax.ShapeDtypeStruct((n_pad,), np.int32), pred["packed_shapes"]
+    )
+    covered = np.zeros(n, dtype=bool)
+    covered[:pred["kept_total"]] = True
+    sub_dims = np.zeros(e, dtype=np.int64)
+    sub_dims[:] = pred["num_features"]
+    return RandomEffectDataset(
+        config=config,
+        num_entities=e,
+        entity_keys=tag.inverse,
+        blocks=tuple(blocks),
+        max_sub_dim=s_all,
+        sub_dims=sub_dims,
+        proj_all=np.full((e, s_all), -1, dtype=np.int64),
+        num_features=pred["num_features"],
+        dtype=game_data.labels.dtype,
+        score_codes=tag.codes,
+        raw=feats,
+        proj_dev=None,
+        block_codes_np=tuple(
+            np.zeros(b, np.int32) for _, b, _ in pred["buckets"]
+        ),
+        block_intercepts_np=tuple(
+            np.zeros(b, np.int32) for _, b, _ in pred["buckets"]
+        ),
+        covered_np=covered,
+        packed_view=packed,
+    )
+
+
 def build_random_effect_dataset(
     game_data: GameDataset,
     config: RandomEffectDataConfiguration,
@@ -1383,10 +1560,11 @@ def build_random_effect_dataset(
         requested_dtype is None
         or jnp.dtype(requested_dtype) == jnp.dtype(game_data.labels.dtype)
     )
-    plan = _plan_random_effect(
-        game_data, config,
-        intercept_index=intercept_index, extra_features=extra_features,
-    )
+    with PIPELINE_STATS.stage("plan"):
+        plan = _plan_random_effect(
+            game_data, config,
+            intercept_index=intercept_index, extra_features=extra_features,
+        )
     if lazy is None:
         # An explicit score-table width cap is a signal that max_sub_dim is
         # dominated by heavy entities (SURVEY §7.3): the lazy scorer's
@@ -1414,9 +1592,11 @@ def build_random_effect_dataset(
     tag = game_data.id_tags[config.random_effect_type]
     num_entities = tag.num_groups
 
-    # Per-bucket plan arrays (all vectorized scatters).
-    bucket_host = []
-    for cap in sorted(plan.bucket_members):
+    # Per-bucket plan arrays (all vectorized scatters). Buckets are
+    # independent, so they build concurrently on the chunk pool; the
+    # ordered wait keeps bucket_host in ascending-cap order, identical to
+    # the serial loop.
+    def _build_bucket(cap: int) -> dict:
         members = plan.bucket_members[cap]
         rows_flat, t_of, r_of, counts_b = _bucket_rows(plan, members, cap)
         b = members.size
@@ -1425,7 +1605,7 @@ def build_random_effect_dataset(
         sub = plan.sub_dims[members]
         s = max(int(sub.max(initial=0)), 1)
         bproj = plan.proj_all[members][:, :s].astype(np.int32)
-        bucket_host.append(dict(
+        return dict(
             cap=cap,
             members=members.astype(np.int32),
             brow=brow,
@@ -1435,7 +1615,16 @@ def build_random_effect_dataset(
             rows_flat=rows_flat,
             t_of=t_of,
             r_of=r_of,
-        ))
+        )
+
+    with PIPELINE_STATS.stage("pack"):
+        bucket_host = [
+            f.result()
+            for f in [
+                chunk_executor.submit(_build_bucket, cap)
+                for cap in sorted(plan.bucket_members)
+            ]
+        ]
 
     covered_np = np.zeros(plan.codes.shape[0], dtype=bool)
     for bh in bucket_host:

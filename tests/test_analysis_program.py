@@ -276,6 +276,7 @@ def test_registry_covers_the_declared_modules():
         "unfused-coordinate-update",
         "newton-kernel",
         "mesh-sharding",
+        "ingest-pipeline",
         "evaluation-scoring",
     } <= set(contracts)
     # Hot-loop coverage: the programs that run inside the fit loop are
