@@ -74,6 +74,14 @@ HONESTY NOTES (all in the output line):
   validate formats, not model quality. The real-data quality anchor is
   the ``a9a_*`` block (32,561 rows, held-out AUC).
 
+The bench runs with runtime telemetry ENABLED (photon_tpu.obs): the
+output's ``telemetry`` object carries the span tree (host/device split),
+metrics registry, last fit's per-coordinate convergence series, and the
+absorbed pipeline/compile-cache reports; ``--telemetry PATH`` also writes
+the JSONL stream (schema: OBSERVABILITY.md). The zero-overhead guarantee
+is audited statically (the tier-2 ``telemetry`` contract) and enforced at
+runtime by this bench's own regression floors.
+
 Prints exactly ONE JSON line.
 """
 
@@ -830,7 +838,11 @@ def run_smoke() -> dict:
     """`bench.py --smoke`: the linear variant at CI scale, one JSON line.
 
     Asserts (in the output, for the CI job to check) that the pipeline
-    stats were emitted with every per-stage field present."""
+    stats were emitted with every per-stage field present and that the
+    telemetry layer actually engaged (span tree recorded, convergence
+    series captured from inside the fused fit)."""
+    from photon_tpu import obs
+
     lin = run_variant("linear")
     pipe = lin["pipeline"]
     stats_ok = all(
@@ -852,6 +864,12 @@ def run_smoke() -> dict:
     if pipe.get("compile_seconds", 0) <= 0:
         regressions.append(
             "AOT warm compile never ran (compile stage empty)")
+    telemetry = obs.snapshot()
+    if not telemetry["spans"]:
+        regressions.append("telemetry recorded no spans")
+    if not telemetry["convergence"]["fits_recorded"]:
+        regressions.append(
+            "no convergence trace captured (fused fit telemetry dead)")
     out = {
         "metric": "glmix_ingest_pipeline_smoke",
         "smoke": True,
@@ -863,6 +881,7 @@ def run_smoke() -> dict:
         "regressions": regressions,
     }
     out.update(_variant_fields("linear", lin))
+    out["telemetry"] = telemetry
     return out
 
 
@@ -877,11 +896,25 @@ def main(argv=None):
         help="CI-scale run: linear variant only, pipeline-stats assertion, "
         "no TPU-scale floors",
     )
+    parser.add_argument(
+        "--telemetry", default=None, metavar="PATH",
+        help="also write the telemetry JSONL stream to PATH "
+        "(schema: OBSERVABILITY.md)",
+    )
     args = parser.parse_args(argv)
 
     # Persistent XLA compile cache: cold runs pay compile_seconds once per
     # machine; repeat runs (and re-runs across rounds) hit the disk cache.
     enable_compilation_cache()
+
+    # Telemetry rides every bench run: the snapshot (span tree with the
+    # host/device split, metrics, per-coordinate convergence series) is
+    # part of the output line, and the zero-overhead contract is audited
+    # statically (`--semantic`, the `telemetry` contract) — the bench's
+    # e2e floors are the runtime half of that guarantee.
+    from photon_tpu import obs
+
+    obs.enable()
 
     if args.smoke:
         _apply_smoke()
@@ -889,6 +922,8 @@ def main(argv=None):
         from photon_tpu.utils import cache_stats
 
         out["compile_cache"] = cache_stats()
+        if args.telemetry:
+            obs.write_jsonl(args.telemetry)
         print(json.dumps(out))
         return
 
@@ -942,6 +977,12 @@ def main(argv=None):
     from photon_tpu.utils import cache_stats
 
     out["compile_cache"] = cache_stats()
+    # The unified telemetry snapshot (photon_tpu.obs): span tree with
+    # host/device split, metrics registry, last fit's per-coordinate
+    # convergence series, pipeline + compile-cache reports.
+    out["telemetry"] = obs.snapshot()
+    if args.telemetry:
+        obs.write_jsonl(args.telemetry)
     print(json.dumps(out))
 
 

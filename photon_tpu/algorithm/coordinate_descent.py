@@ -85,9 +85,26 @@ class CoordinateUpdateRecord:
 
     On the FUSED whole-fit path (algorithm/fused_fit.py) the entire
     descent is one device program, so not even dispatch time exists per
-    coordinate: ``seconds`` is ``None`` there, and the total lives on the
-    fit result / driver timings. Consumers must treat ``None`` as
-    "unattributable", not zero.
+    coordinate. The contract there is two-valued:
+
+    - telemetry OFF (``photon_tpu.obs`` disabled, the default):
+      ``seconds`` is ``None`` — never a synthetic split consumers would
+      read as measured;
+    - telemetry ON: the fused fit's root span measures the fit
+      program's real dispatch->completion window (one
+      ``block_until_ready`` at the span root; slab materialization and
+      the AOT compile wait are excluded), and ``seconds`` is that
+      measurement's analytic ATTRIBUTION to this record — weighted by
+      the coordinate's measured solver iteration counts x static shape
+      work (``FusedFit._attribute_seconds``). Attributed shares sum to
+      the measured fit window; treat them as a breakdown of one real
+      measurement, not as independent per-coordinate timings. A fit
+      whose window was NOT pure execution — the cold jit-fallback entry
+      that traces/compiles inside the dispatch call — keeps ``None``
+      (the span's ``fit_window_pure`` attr says why); only AOT-served
+      and warm re-entries attribute.
+
+    Consumers must treat ``None`` as "unattributable", not zero.
     """
 
     iteration: int
@@ -191,33 +208,40 @@ class CoordinateDescent:
         val_scores: dict[str, Array] = {}
         val_total: Array | None = None
 
+        from photon_tpu import obs
+
         for it in range(self.num_iterations):
             for cid in self.update_sequence:
                 if cid in self.locked_coordinates:
                     continue
                 coord = coordinates[cid]
                 t0 = time.perf_counter()
-                residuals = None
-                if total is not None:
-                    residuals = total
-                    if cid in scores:
-                        residuals = residuals - scores[cid]
-                model, diag = coord.train(
-                    residuals=residuals,
-                    initial_model=models.get(cid),
-                    seed=seed + it,
-                )
-                new_scores = coord.score(model)
-                _serialize_on_cpu_mesh(new_scores)
-                # summedScores - oldScores + previousScores (:442,583).
-                # One jitted program: each eager arithmetic op costs a
-                # ~0.5s one-off compile on the tunneled TPU backend.
-                if total is None:
-                    total = new_scores
-                elif cid in scores:
-                    total = _sub_add(total, scores[cid], new_scores)
-                else:
-                    total = total + new_scores
+                # Telemetry span mirrors the measured dispatch window
+                # below (host-side only; the obs tree's unfused analog of
+                # the fused fit's single whole-fit span — no sync here:
+                # per-update syncs are exactly what this loop avoids).
+                with obs.span(f"coord:{cid}", attrs={"iteration": it}):
+                    residuals = None
+                    if total is not None:
+                        residuals = total
+                        if cid in scores:
+                            residuals = residuals - scores[cid]
+                    model, diag = coord.train(
+                        residuals=residuals,
+                        initial_model=models.get(cid),
+                        seed=seed + it,
+                    )
+                    new_scores = coord.score(model)
+                    _serialize_on_cpu_mesh(new_scores)
+                    # summedScores - oldScores + previousScores (:442,583).
+                    # One jitted program: each eager arithmetic op costs a
+                    # ~0.5s one-off compile on the tunneled TPU backend.
+                    if total is None:
+                        total = new_scores
+                    elif cid in scores:
+                        total = _sub_add(total, scores[cid], new_scores)
+                    else:
+                        total = total + new_scores
                 models[cid] = model
                 scores[cid] = new_scores
                 seconds = time.perf_counter() - t0

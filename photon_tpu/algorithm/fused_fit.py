@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 
 import jax
 import jax.numpy as jnp
@@ -364,6 +365,11 @@ class FusedFit:
         # static key and operand avals match, else the normal jit path.
         self._aot_future = None
         self._aot: dict | None = None
+        # Statics tuples already executed through the jit fallback: the
+        # FIRST such call traces (and possibly compiles) INSIDE the
+        # telemetry attribution window, so that window is not pure fit
+        # execution and must not be attributed to coordinate records.
+        self._jit_seen: set[tuple] = set()
 
     # ------------------------------------------------------------------
     # operand assembly (per run; cheap)
@@ -633,6 +639,18 @@ class FusedFit:
 
     def _fit_fn(self, ops, ebs_all, *, statics):
         num_iters = self.num_iterations
+        # Convergence telemetry rides the fit program UNCONDITIONALLY as
+        # extra outputs (obs/convergence.py METRICS columns): the
+        # telemetry enable flag is host-side only, so the traced program
+        # — and with it the dispatch census and every recompile key — is
+        # byte-identical with telemetry on or off (the audited
+        # `telemetry` contract in photon_tpu/obs/__init__.py).
+        conv_index = {
+            i: j
+            for j, i in enumerate(
+                i for i, st in enumerate(statics) if st[0] != "locked"
+            )
+        }
 
         # --- initial state ------------------------------------------------
         states: list = []
@@ -684,9 +702,12 @@ class FusedFit:
                     jnp.zeros((num_iters, e), jnp.int32),
                 ))
             total = scores[-1] if total is None else total + scores[-1]
+        conv0 = jnp.zeros(
+            (num_iters, len(conv_index), 5), dtype=total.dtype
+        )
 
         def sweep(it, carry):
-            states, scores, total, diags = carry
+            states, scores, total, diags, conv = carry
             states = list(states)
             scores = list(scores)
             diags = list(diags)
@@ -700,6 +721,7 @@ class FusedFit:
                         var_comp = st[:6]
                     batch = op["batch"]
                     batch = batch.with_offsets(batch.offsets + residual)
+                    prev_means = states[i][0]
                     means, variances, result = _run_impl(
                         batch,
                         states[i][0],
@@ -720,6 +742,12 @@ class FusedFit:
                         it_arr.at[it].set(result.iterations),
                         rs_arr.at[it].set(result.convergence_reason),
                     )
+                    # Solver-final objective/gradient come free from the
+                    # OptResult — no extra passes over the batch.
+                    conv_loss = result.value
+                    conv_gnorm = result.gradient_norm
+                    conv_wd = jnp.sum((means - prev_means) ** 2)
+                    conv_wn = jnp.sum(means ** 2)
                 else:
                     _, task, opt_config, use_owlqn, var_comp, direct, \
                         newton = st[:7]
@@ -760,13 +788,34 @@ class FusedFit:
                         it_arr.at[it].set(its_e),
                         rs_arr.at[it].set(rs_e),
                     )
+                    # The batched per-entity solvers return iteration
+                    # counts, not objective values: loss/grad_norm are 0
+                    # for random effects (obs/convergence.py documents
+                    # the column contract); the deltas below are the
+                    # convergence signal that exists for every kind.
+                    conv_loss = jnp.zeros((), total.dtype)
+                    conv_gnorm = jnp.zeros((), total.dtype)
+                    conv_wd = jnp.sum((w_all - w_prev) ** 2)
+                    conv_wn = jnp.sum(w_all ** 2)
+                # residual_delta_sq: movement of this coordinate's score
+                # contribution this sweep — computed on values the
+                # residual bookkeeping already holds (no extra passes).
+                conv = conv.at[it, conv_index[i]].set(
+                    jnp.stack([
+                        conv_loss.astype(total.dtype),
+                        conv_gnorm.astype(total.dtype),
+                        jnp.sum((z - scores[i]) ** 2).astype(total.dtype),
+                        conv_wd.astype(total.dtype),
+                        conv_wn.astype(total.dtype),
+                    ])
+                )
                 total = total - scores[i] + z
                 scores[i] = z
-            return tuple(states), tuple(scores), total, tuple(diags)
+            return tuple(states), tuple(scores), total, tuple(diags), conv
 
-        carry = (tuple(states), tuple(scores), total, tuple(diags))
+        carry = (tuple(states), tuple(scores), total, tuple(diags), conv0)
         carry = lax.fori_loop(0, num_iters, sweep, carry)
-        states, scores, total, diags = carry
+        states, scores, total, diags, conv = carry
         # Pack every diagnostic array into ONE int32 buffer: a host pull
         # costs a fixed round trip on remote backends, so one buffer beats
         # 2 x n_coordinates of them (_PackedDiags splits host-side).
@@ -777,13 +826,72 @@ class FusedFit:
             jnp.concatenate(flat_parts) if flat_parts
             else jnp.zeros(0, jnp.int32)
         )
-        return states, scores, total, packed
+        return states, scores, total, packed, conv
 
     def _fe_norm(self, i):
         """NormalizationContext for coordinate i (host constant — factor
         arrays are tiny [d] vectors; embedding them as program constants
         is deliberate)."""
         return self._norms[i]
+
+    def _attribute_seconds(
+        self, total_seconds: float, ops, packed: _PackedDiags, diag_index
+    ) -> dict[tuple[int, str], float] | None:
+        """Per-(iteration, coordinate) attribution of the fit's measured
+        wall — the span tracer's device-time split for fused records.
+
+        The fit is ONE program, so per-coordinate time cannot be measured
+        directly; this distributes ``total_seconds`` — the fit program's
+        REAL dispatch->completion window, measured by the run span's
+        root sync — proportionally to each block's analytic work estimate
+        (the same counting family as bench.estimate_model_flops), using
+        the MEASURED per-iteration solver counts from the packed
+        diagnostics: fixed effects at iters x 4nd value/grad passes +
+        scoring, random effects at mean-Newton-iters x (margins + Hessian
+        contraction) over active rows + per-entity Cholesky + scoring.
+        Shares sum to the measurement; they are attribution, not
+        independent timings (CoordinateUpdateRecord documents the
+        contract). Returns None when no work was attributable.
+        """
+        weights: dict[tuple[int, str], float] = {}
+        for i, cid in enumerate(self.seq):
+            kind = self.kinds[cid]
+            if kind == "locked":
+                continue
+            it_idx, _ = diag_index[cid]
+            iters = packed.get(it_idx)  # [T] fixed / [T, entities] random
+            if kind == "fixed":
+                n = ops[i]["batch"].num_samples
+                d = ops[i]["batch"].num_features
+                for it in range(self.num_iterations):
+                    weights[(it, cid)] = (
+                        (4.0 * max(float(iters[it]), 1.0) + 2.0) * n * d
+                    )
+            else:
+                n_re = int(ops[i]["score_codes"].shape[0])
+                _, s = ops[i]["w0"].shape
+                # Only entities the blocks actually solve (the same keep
+                # mask the diagnostics apply): phantom padded slots would
+                # deflate the measured mean iteration count and inflate
+                # the Cholesky term.
+                keep = self._re_meta[cid]["keep"]
+                kept = int(keep.sum())
+                for it in range(self.num_iterations):
+                    its_it = iters[it][keep] if kept else iters[it]
+                    mean_it = max(
+                        float(np.mean(its_it)) if its_it.size else 1.0,
+                        1.0,
+                    )
+                    weights[(it, cid)] = (
+                        mean_it * (6.0 * s + 2.0 * s * s) * n_re
+                        + max(kept, 1) * s ** 3 / 3.0
+                        + 2.0 * n_re * s
+                    )
+        total_w = sum(weights.values())
+        if total_w <= 0.0:
+            return None
+        scale = float(total_seconds) / total_w
+        return {k: v * scale for k, v in weights.items()}
 
     # ------------------------------------------------------------------
     # abstract lowering (the semantic auditor / cost model entry)
@@ -870,35 +978,72 @@ class FusedFit:
         coords: dict[str, object],
         initial_models: dict[str, object] | None = None,
     ) -> CoordinateDescentResult:
-        ops = self._operands(coords, initial_models)
-        statics = self._statics(coords, initial_models)
-        aot = self._consume_aot()
-        # Slabs materialize once per dataset generation (separate cached
-        # program that also unpacks the ingest's packed plan buffer);
-        # every fit's program receives the results as plain operands.
-        # When the estimator provides a share, sibling programs (other
-        # static keys of the same generation) reuse the same device slabs.
-        if self._mat_shared is not None:
-            ebs_all = self._mat_shared.get("ebs")
-            if ebs_all is None:
-                ebs_all = self._mat_shared["ebs"] = self._run_mat(
-                    coords, aot)
-        else:
-            if self._mat_cache is None:
-                self._mat_cache = self._run_mat(coords, aot)
-            ebs_all = self._mat_cache
-        out = None
-        if aot is not None and statics == aot.get("statics"):
-            try:
-                out = aot["fit"](ops, ebs_all)
-            except Exception:  # noqa: BLE001 — stale shape prediction
-                logger.info(
-                    "ingest pipeline: AOT fit executable incompatible "
-                    "with the built datasets; recompiling")
-                self._aot = None
-        if out is None:
-            out = self._jit(ops, ebs_all, statics=statics)
-        states, scores, total, packed_flat = out
+        from photon_tpu import obs
+
+        # The whole-fit span is the telemetry layer's device-time ROOT:
+        # with telemetry enabled it syncs on the program outputs at exit
+        # (the one host sync per fit, at the point the caller's first
+        # blocking read would have paid anyway) so the host/device split
+        # and the per-record attribution below come from a real
+        # measurement. Disabled, the span is a no-op and the dispatch
+        # stays fully asynchronous — the pre-telemetry behavior.
+        with obs.span("fused_fit") as sp:
+            ops = self._operands(coords, initial_models)
+            statics = self._statics(coords, initial_models)
+            aot = self._consume_aot()
+            # Slabs materialize once per dataset generation (separate
+            # cached program that also unpacks the ingest's packed plan
+            # buffer); every fit's program receives the results as plain
+            # operands. When the estimator provides a share, sibling
+            # programs (other static keys of the same generation) reuse
+            # the same device slabs.
+            if self._mat_shared is not None:
+                ebs_all = self._mat_shared.get("ebs")
+                if ebs_all is None:
+                    ebs_all = self._mat_shared["ebs"] = self._run_mat(
+                        coords, aot)
+            else:
+                if self._mat_cache is None:
+                    self._mat_cache = self._run_mat(coords, aot)
+                ebs_all = self._mat_cache
+            # The attribution window opens HERE: operand assembly, the
+            # AOT compile wait, and slab materialization above are not
+            # fit work and must not be charged to coordinate records.
+            t_fit0 = time.perf_counter()
+            out = None
+            fit_window_pure = True
+            if aot is not None and statics == aot.get("statics"):
+                try:
+                    out = aot["fit"](ops, ebs_all)
+                except Exception:  # noqa: BLE001 — stale shape prediction
+                    logger.info(
+                        "ingest pipeline: AOT fit executable incompatible "
+                        "with the built datasets; recompiling")
+                    self._aot = None
+            if out is None:
+                # A first jit-fallback entry traces + compiles inside
+                # the window: not pure fit execution (see _jit_seen).
+                fit_window_pure = statics in self._jit_seen
+                out = self._jit(ops, ebs_all, statics=statics)
+                self._jit_seen.add(statics)
+            states, scores, total, packed_flat, conv = out
+            if sp is not None:
+                sp.sync = out
+        if sp is not None:
+            obs.convergence.record(
+                tuple(
+                    cid for cid in self.seq
+                    if self.kinds[cid] != "locked"
+                ),
+                conv,
+            )
+            obs.REGISTRY.counter("fused_fits_total").inc()
+            obs.REGISTRY.histogram("fused_fit_wall_seconds").observe(
+                sp.seconds)
+            if sp.device_wait_seconds is not None:
+                obs.REGISTRY.histogram(
+                    "fused_fit_device_wait_seconds"
+                ).observe(sp.device_wait_seconds)
         # Diagnostic shapes, in the exact flattening order of _fit_fn's
         # packing; indices into _PackedDiags per coordinate.
         shapes: list[tuple] = []
@@ -919,10 +1064,43 @@ class FusedFit:
 
         models: dict[str, object] = {}
         history: list[CoordinateUpdateRecord] = []
-        # The whole descent is ONE device program here: per-coordinate time
-        # does not exist, not even as dispatch time. Records carry
-        # seconds=None (see CoordinateUpdateRecord) instead of a synthetic
-        # uniform split that consumers would read as measured.
+        # The whole descent is ONE device program here: per-coordinate
+        # time is not independently measurable. With telemetry DISABLED,
+        # records carry seconds=None (never a synthetic uniform split
+        # consumers would read as measured). With telemetry ENABLED the
+        # span above measured the fit program's real dispatch->
+        # completion window (materialize/AOT-wait excluded), and each
+        # record gets its analytic ATTRIBUTION of that measurement —
+        # weighted by the coordinate's measured iteration counts x
+        # static shape work (see _attribute_seconds and the
+        # CoordinateUpdateRecord contract).
+        rec_seconds = None
+        if sp is not None and sp.device_wait_seconds is not None:
+            # The attributed total is the FIT window only — from the fit
+            # program's dispatch (t_fit0, after materialize/AOT wait) to
+            # the span's post-sync completion — so compile_wait and slab
+            # gathering never masquerade as per-coordinate device work.
+            # A cold jit-fallback entry traces/compiles INSIDE that
+            # window, so it is attributed only when pure: cold-fallback
+            # records keep seconds=None (the pipeline stats report the
+            # compile separately) and the span carries fit_window_pure
+            # so exporters can say why.
+            fit_seconds = max(sp.t1 - t_fit0, 0.0)
+            if sp.attrs is None:
+                sp.attrs = {}
+            sp.attrs["fit_seconds"] = round(fit_seconds, 6)
+            sp.attrs["fit_window_pure"] = fit_window_pure
+            if fit_window_pure:
+                # This forces the packed-diagnostics host pull per fit —
+                # a deliberate trade against laziness: records carry a
+                # plain float (frozen-dataclass API), the buffer is
+                # already synced by the span root (zero-copy on CPU,
+                # ~1ms DMA at bench scale on a local chip; only a
+                # tunneled backend pays a latency round trip), and the
+                # pull shares _PackedDiags' cache, so diagnostics
+                # consumers never fetch a second time.
+                rec_seconds = self._attribute_seconds(
+                    fit_seconds, ops, packed, diag_index)
         for i, cid in enumerate(self.seq):
             coord = coords[cid]
             kind = self.kinds[cid]
@@ -973,7 +1151,10 @@ class FusedFit:
                 history.append(CoordinateUpdateRecord(
                     iteration=it,
                     coordinate_id=cid,
-                    seconds=None,
+                    seconds=(
+                        None if rec_seconds is None
+                        else rec_seconds[(it, cid)]
+                    ),
                     diagnostics=diag,
                     evaluation=None,
                 ))

@@ -81,6 +81,7 @@ DECLARING_MODULES = (
     "photon_tpu.algorithm.fused_fit",
     "photon_tpu.data.pipeline",
     "photon_tpu.estimators.game_estimator",
+    "photon_tpu.obs",
     "photon_tpu.ops.newton_kernel",
     "photon_tpu.parallel.mesh",
 )
@@ -935,6 +936,77 @@ def build_ingest_pipeline() -> ContractTrace:
     )
 
 
+def build_telemetry() -> ContractTrace:
+    """The telemetry layer's audited zero-overhead guarantee.
+
+    The instrumented entry points — the fused materialize + whole-fit
+    programs that every obs span wraps and every convergence trace rides
+    — are traced twice, with telemetry DISABLED (base) and ENABLED
+    (the ``telemetry_toggle`` variant family). The checks then prove:
+
+    - **zero dispatches added**: the census across both states stays at
+      the fused generation's own 2 programs — enabling telemetry mints
+      no executable (convergence metrics are unconditional outputs of
+      the existing fit program, never a side program or a split);
+    - **zero host callbacks**: the hot-loop host-boundary walk over the
+      (shared) jaxpr finds no callback primitive — spans and the async
+      convergence fetch live entirely OUTSIDE the trace;
+    - **identical recompile keys**: ``stable_under=telemetry_toggle`` —
+      the enabled-state signatures must be byte-identical to the
+      disabled-state ones, so flipping telemetry can never trigger a
+      recompile in production.
+    """
+    from photon_tpu import obs
+
+    with _serial_ingest_env():
+        est, data = _tiny_glmix()
+        datasets, _ = est.prepare(data)
+        coords = est._build_coordinates(
+            datasets, {}, {}, data.num_samples
+        )
+        fused = est._fused_for(coords, datasets)
+        was_enabled = obs.enabled()
+        obs.disable()
+        try:
+            mat_off = trace_program(
+                "materialize", fused._mat_jit, fused._mat_operands(coords)
+            )
+            traced_off = fused.trace(coords)
+            fit_off = TracedProgram(
+                name="fit",
+                text=str(traced_off.jaxpr),
+                jaxpr=traced_off.jaxpr,
+                lowered=traced_off.lower(),
+            )
+            obs.enable()
+            mat_on = trace_program(
+                "materialize", fused._mat_jit, fused._mat_operands(coords)
+            )
+            traced_on = fused.trace(coords)
+            fit_on = TracedProgram(
+                name="fit", text=str(traced_on.jaxpr)
+            )
+        finally:
+            obs.TRACER.enabled = was_enabled
+    return ContractTrace(
+        programs={"materialize": mat_off, "fit": fit_off},
+        variants={
+            "telemetry_toggle": [
+                {
+                    "materialize": mat_on.signature,
+                    "fit": fit_on.signature,
+                }
+            ]
+        },
+        notes=[
+            "telemetry on vs off traced the same materialize/fit "
+            "jaxprs: the enable flag is host-side only (convergence "
+            "metrics are unconditional program outputs; spans never "
+            "enter a trace)",
+        ],
+    )
+
+
 def build_evaluators() -> ContractTrace:
     """Evaluation + scoring entry points: shape-specialized (a row-count
     change recompiles, by design), value-stable, no host callbacks."""
@@ -981,6 +1053,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_newton_kernel": build_newton_kernel,
     "build_mesh_sharding": build_mesh_sharding,
     "build_ingest_pipeline": build_ingest_pipeline,
+    "build_telemetry": build_telemetry,
     "build_evaluators": build_evaluators,
 }
 

@@ -122,7 +122,7 @@ def _run(args, log, t_start) -> int:
     )
     from photon_tpu.stat import FeatureDataStatistics
     from photon_tpu.types import TaskType
-    from photon_tpu.utils import Timed
+    from photon_tpu import obs
 
     task = TaskType(args.task.upper())
     task_name = task.name
@@ -135,7 +135,7 @@ def _run(args, log, t_start) -> int:
     os.makedirs(args.output_dir, exist_ok=True)
 
     # ---- stage PREPROCESSED (Driver.scala preprocess) --------------------
-    with Timed("preprocess", log):
+    with obs.logged_span("preprocess", log):
         if args.format == "libsvm":
             # -1/+1 -> 0/1 label mapping is a BINARY convention; regression
             # labels legitimately go negative and must pass through.
@@ -223,7 +223,7 @@ def _run(args, log, t_start) -> int:
     )
 
     models: list[tuple[float, object]] = []
-    with Timed("train lambda sweep", log):
+    with obs.logged_span("train lambda sweep", log):
         prev = None
         for lam in lambdas:
             cfg = GLMOptimizationConfiguration(
@@ -252,7 +252,7 @@ def _run(args, log, t_start) -> int:
     metrics_by_lambda: dict[str, dict[str, float]] = {}
     best_lambda = lambdas[0]
     if val_batch is not None:
-        with Timed("validate", log):
+        with obs.logged_span("validate", log):
             suite = make_suite(
                 _METRICS[task_name],
                 val_batch.labels,

@@ -34,6 +34,13 @@ def main(argv=None) -> int:
     parser.add_argument("--log-file", default=None,
                         help="also write logs to this file (PhotonLogger "
                              "equivalent, util/PhotonLogger.scala:34)")
+    parser.add_argument("--telemetry", default=None, metavar="PATH",
+                        help="enable runtime telemetry (photon_tpu.obs) "
+                             "and write the JSONL stream to PATH; the "
+                             "snapshot also lands in "
+                             "training-summary.json (OBSERVABILITY.md). "
+                             "Resets the process's telemetry stream: "
+                             "the run owns its stream end to end")
     args = parser.parse_args(argv)
 
     if args.backend:
@@ -45,7 +52,42 @@ def main(argv=None) -> int:
 
         enable_compilation_cache()  # persistent XLA cache: warm runs skip compiles
         maybe_init_distributed()
-        return _run(args)
+        if args.telemetry:
+            from photon_tpu import obs
+
+            was_enabled = obs.enabled()
+            # DESTRUCTIVE by design: the --telemetry run owns the
+            # process's telemetry stream (a JSONL mixing a prior
+            # session's records into this run's artifact would be
+            # worse); only the enabled flag is restored afterwards —
+            # in-process callers who need their accumulated records
+            # must snapshot before invoking main().
+            obs.reset()
+            obs.enable()
+        try:
+            return _run(args)
+        finally:
+            if args.telemetry:
+                from photon_tpu import obs
+
+                try:
+                    obs.write_jsonl(args.telemetry)
+                    logging.getLogger("photon.train").info(
+                        "telemetry JSONL written to %s\n%s",
+                        args.telemetry, obs.summary_table(),
+                    )
+                except Exception:
+                    # Telemetry must never mask the run's own outcome:
+                    # a bad --telemetry path on a failed run would
+                    # otherwise replace the real training exception.
+                    logging.getLogger("photon.train").exception(
+                        "failed to write telemetry to %s", args.telemetry
+                    )
+                # Restore the caller's prior ENABLED FLAG (the recorded
+                # stream was reset above, by design) so an in-process
+                # caller that keeps telemetry on — the bench's wide-d
+                # block — continues recording after we return.
+                obs.TRACER.enabled = was_enabled
 
 
 def _run(args) -> int:
@@ -67,7 +109,12 @@ def _run(args) -> int:
     )
     from photon_tpu.stat import FeatureDataStatistics
     from photon_tpu.types import TaskType
-    from photon_tpu.utils import Timed, profile_trace
+    from photon_tpu.utils import profile_trace
+
+    # Section timing rides the unified telemetry layer; obs.logged_span
+    # keeps the reference's Timed/PhotonLogger "begin execution" /
+    # "executed in" log contract for the --log-file sink.
+    from photon_tpu import obs
 
     t_start = time.time()
     cfg = TrainingConfig.load(args.config)
@@ -316,9 +363,10 @@ def _run(args) -> int:
     estimator = cfg.build_estimator(norm_contexts, intercept_indices)
     opt_seq = cfg.opt_config_sequence()
     log.info("training %d configuration(s)", len(opt_seq))
-    with Timed("prepare training datasets", log):
+    with obs.logged_span("prepare training datasets", log):
         estimator.prepare(train, validation, initial_model)
-    with Timed("train models", log), profile_trace(cfg.profile_dir):
+    with obs.logged_span("train models", log), \
+            profile_trace(cfg.profile_dir):
         results = estimator.fit(
             train, validation, opt_seq, initial_model=initial_model
         )
@@ -403,6 +451,14 @@ def _run(args) -> int:
         ],
         "wall_clock_seconds": round(time.time() - t_start, 2),
     }
+    if args.telemetry:
+        # The unified telemetry snapshot (span tree with host/device
+        # split, metrics, convergence series, pipeline + compile-cache
+        # reports) rides the summary artifact; the full per-record
+        # stream goes to the --telemetry JSONL path in main().
+        from photon_tpu import obs
+
+        summary["telemetry"] = obs.snapshot()
     if write_outputs:
         with open(
             os.path.join(cfg.output_dir, "training-summary.json"), "w"

@@ -196,18 +196,34 @@ class PipelineStats:
 
     @contextlib.contextmanager
     def stage(self, name: str):
+        # Every stage also lands in the unified telemetry layer: a
+        # "pipeline/<stage>" span (worker-thread stages root their own
+        # subtree, labeled by thread) plus a per-stage histogram. Both
+        # are no-ops while telemetry is disabled; this accounting stays
+        # authoritative either way.
+        from photon_tpu import obs
+
         with self._lock:
             gen = self._generation
         t0 = time.perf_counter()
         try:
-            yield
+            with obs.span(f"pipeline/{name}"):
+                yield
         finally:
             t1 = time.perf_counter()
             with self._lock:
                 # A stale generation token (reset() ran mid-stage, e.g.
                 # an orphaned background compile) records nothing — it
-                # must not pollute the new generation's report.
+                # must not pollute the new generation's report. The
+                # telemetry histogram below follows the SAME rule so the
+                # two absorbed views never diverge (the span above still
+                # records: spans are a faithful trace of wall events,
+                # not generation accounting).
                 if gen == self._generation:
+                    if obs.enabled():
+                        obs.REGISTRY.histogram(
+                            "pipeline_stage_seconds", stage=name
+                        ).observe(t1 - t0)
                     self._seconds[name] = self._seconds.get(
                         name, 0.0
                     ) + (t1 - t0)

@@ -808,12 +808,15 @@ class GameEstimator:
             self._aot_future = pipeline.compile_executor.submit(
                 self._warm_compile, data
             )
-        datasets = self._build_datasets(data, initial_model)
-        val_ctx = (
-            self._build_validation(datasets, validation)
-            if validation is not None
-            else None
-        )
+        from photon_tpu import obs
+
+        with obs.span("prepare"):
+            datasets = self._build_datasets(data, initial_model)
+            val_ctx = (
+                self._build_validation(datasets, validation)
+                if validation is not None
+                else None
+            )
         self._fit_cache = (cache_key, (datasets, val_ctx))
         return datasets, val_ctx
 
@@ -922,13 +925,16 @@ class GameEstimator:
             # Injective seed spacing: CD uses seed+iteration internally, so
             # stride by num_iterations to keep down-sampling draws
             # independent across the lambda-config grid.
-            if fused is not None:
-                descent = fused.run(coords, initial_models or None)
-            else:
-                descent = cd.run(
-                    coords, initial_models or None, val_ctx,
-                    seed=i * self.num_iterations,
-                )
+            from photon_tpu import obs
+
+            with obs.span(f"fit/config:{i}"):
+                if fused is not None:
+                    descent = fused.run(coords, initial_models or None)
+                else:
+                    descent = cd.run(
+                        coords, initial_models or None, val_ctx,
+                        seed=i * self.num_iterations,
+                    )
             full_config = {
                 cid: opt_configs.get(cid, self.coordinate_configs[cid].optimization)
                 for cid in self.update_sequence

@@ -8,15 +8,21 @@ GAME path emits directly from ``CoordinateDescent`` and ``GameEstimator``,
 so callers can observe training progress (per-coordinate diagnostics,
 per-config results) without polling or log scraping.
 
-Listeners are plain callables ``listener(event) -> None``; exceptions
-propagate (a listener that raises aborts training, matching the reference's
-synchronous ``foreach`` fan-out).
+Listeners are plain callables ``listener(event) -> None``. By default
+exceptions propagate (a listener that raises aborts training, matching the
+reference's synchronous ``foreach`` fan-out); construct the emitter with
+``safe_listeners=True`` — or pass ``isolate=True`` to a single
+``send_event`` call — to log-and-continue instead, so one broken observer
+(a telemetry sink, a progress bar) cannot abort a multi-hour fit.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,8 +50,10 @@ class CoordinateUpdateEvent(PhotonEvent):
 
     @property
     def seconds(self) -> float | None:
-        # None on the fused whole-fit path (one device program: no
-        # per-coordinate dispatch time exists; see CoordinateUpdateRecord).
+        # None on the fused whole-fit path with telemetry off (one device
+        # program: no per-coordinate dispatch time exists); an attributed
+        # share of the fit's measured wall with telemetry on. See the
+        # CoordinateUpdateRecord contract.
         return self.record.seconds
 
     @property
@@ -70,10 +78,19 @@ Listener = Callable[[PhotonEvent], None]
 
 
 class EventEmitter:
-    """Listener registry with synchronous fan-out (EventEmitter.scala:24)."""
+    """Listener registry with synchronous fan-out (EventEmitter.scala:24).
 
-    def __init__(self, listeners=None):
+    ``safe_listeners`` selects the default fault-isolation mode:
+    ``False`` (the reference's semantics) lets a raising listener abort
+    the caller; ``True`` logs the exception and continues with the
+    remaining listeners. ``send_event(..., isolate=...)`` overrides the
+    default per call. Fan-out stays synchronous in both modes — events
+    arrive on the training thread, in order.
+    """
+
+    def __init__(self, listeners=None, *, safe_listeners: bool = False):
         self._listeners: list[Listener] = list(listeners or ())
+        self.safe_listeners = safe_listeners
 
     def add_listener(self, listener: Listener) -> None:
         self._listeners.append(listener)
@@ -84,6 +101,20 @@ class EventEmitter:
     def clear_listeners(self) -> None:
         self._listeners.clear()
 
-    def send_event(self, event: PhotonEvent) -> None:
+    def send_event(
+        self, event: PhotonEvent, *, isolate: bool | None = None
+    ) -> None:
+        if isolate is None:
+            isolate = self.safe_listeners
+        if not isolate:
+            for listener in self._listeners:
+                listener(event)
+            return
         for listener in self._listeners:
-            listener(event)
+            try:
+                listener(event)
+            except Exception:  # noqa: BLE001 — isolation is the contract
+                logger.exception(
+                    "event listener %r raised on %r; continuing "
+                    "(isolated fan-out)", listener, type(event).__name__,
+                )

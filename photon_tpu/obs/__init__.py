@@ -1,0 +1,134 @@
+"""photon_tpu.obs — unified runtime telemetry.
+
+One coherent layer over what used to be four unconnected surfaces
+(``utils/timed.py`` section logs, ``data/pipeline.py::PIPELINE_STATS``,
+``utils/compile_cache.cache_stats()``, and the ``events.py`` listener
+bus): hierarchical **spans** with a host/device split measured only at
+span roots (``obs/spans.py``), a labeled **metrics registry**
+(``obs/metrics.py``), **async device-side convergence traces** computed
+inside the already-traced fit programs (``obs/convergence.py``), and
+**exporters** — ``snapshot()`` for bench/driver JSON, a documented JSONL
+stream, and an end-of-run text table (``obs/export.py``; schema in
+OBSERVABILITY.md).
+
+Telemetry is OFF by default and enabling it is a host-side decision
+only: the device programs are identical either way. That is not a
+promise but an audited contract — see PROGRAM_AUDIT below.
+
+Usage::
+
+    from photon_tpu import obs
+
+    obs.enable()
+    with obs.span("prepare"):
+        datasets, _ = est.prepare(data)
+    ...
+    print(obs.summary_table())
+    obs.write_jsonl("run-telemetry.jsonl")
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+from photon_tpu.obs import convergence
+from photon_tpu.obs.export import (
+    snapshot,
+    summary_table,
+    validate_jsonl,
+    write_jsonl,
+)
+from photon_tpu.obs.metrics import (
+    REGISTRY,
+    MetricsRegistry,
+    metrics_listener,
+)
+from photon_tpu.obs.spans import Span, SpanTracer
+
+TRACER = SpanTracer()
+span = TRACER.span
+
+# Program contract (audited by `python -m photon_tpu.analysis --semantic`;
+# machinery in analysis/program.py build_telemetry): the instrumented
+# public entry points — the fused materialize + whole-fit programs, the
+# ones every obs span and convergence trace hangs off — must trace to
+# BYTE-IDENTICAL jaxprs with telemetry enabled vs disabled. Zero new
+# dispatches (census bound is the fused generation's own 2 programs),
+# zero host callbacks (hot_loop), identical recompile keys
+# (stable_under=telemetry_toggle). Convergence metrics achieve this by
+# being UNCONDITIONAL outputs of the fit program: the enable flag only
+# controls host-side recording, never the trace.
+PROGRAM_AUDIT = dict(
+    name="telemetry",
+    entry="obs instrumentation over algorithm.fused_fit "
+    "(materialize + whole-fit programs, telemetry on vs off)",
+    builder="build_telemetry",
+    max_programs=2,
+    stable_under=("telemetry_toggle",),
+    hot_loop=True,
+)
+
+
+@contextlib.contextmanager
+def logged_span(msg: str, log: logging.Logger | None = None):
+    """A span that also keeps the reference's ``Timed`` logging contract
+    ("<msg>: begin execution" / "<msg>: executed in <t> s",
+    util/Timed.scala:53-80) — THE one logged-section helper; the CLI
+    drivers and the deprecated ``utils.Timed`` shim all route here so the
+    log contract and the span naming live in a single place."""
+    log = log or logging.getLogger("photon_tpu.timed")
+    log.info("%s: begin execution", msg)
+    t0 = time.perf_counter()
+    try:
+        with span(msg):
+            yield
+    finally:
+        log.info(
+            "%s: executed in %.3f s", msg, time.perf_counter() - t0
+        )
+
+
+def enable() -> None:
+    """Turn telemetry on: spans record, fit-level roots sync for the
+    host/device split, convergence traces are parked for async fetch."""
+    TRACER.enabled = True
+
+
+def disable() -> None:
+    TRACER.enabled = False
+
+
+def enabled() -> bool:
+    return TRACER.enabled
+
+
+def reset() -> None:
+    """Drop all recorded telemetry (spans, metrics, convergence traces).
+    Does not touch the enabled flag."""
+    TRACER.reset()
+    REGISTRY.reset()
+    convergence.reset()
+
+
+__all__ = [
+    "PROGRAM_AUDIT",
+    "REGISTRY",
+    "MetricsRegistry",
+    "Span",
+    "SpanTracer",
+    "TRACER",
+    "convergence",
+    "disable",
+    "enable",
+    "enabled",
+    "logged_span",
+    "metrics_listener",
+    "reset",
+    "snapshot",
+    "span",
+    "summary_table",
+    "validate_jsonl",
+    "write_jsonl",
+]

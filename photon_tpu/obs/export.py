@@ -1,0 +1,218 @@
+"""Telemetry exporters: snapshot dict, JSONL stream, text summary.
+
+Three views over the same state (span tracer + metrics registry +
+convergence traces + the pipeline/compile-cache reports they absorb):
+
+- ``snapshot()``: one JSON-ready dict — what ``bench.py`` embeds under
+  ``"telemetry"`` and what the train CLI folds into
+  ``training-summary.json``;
+- ``write_jsonl(path)``: the documented line-per-record stream
+  (schema: OBSERVABILITY.md; ``validate_jsonl`` is the shared validator
+  CI runs against the smoke artifact);
+- ``summary_table()``: the end-of-run human-readable table.
+
+JSONL SCHEMA (version 1) — one JSON object per line, discriminated by
+``type``:
+
+  {"type": "telemetry", "version": 1, "spans_dropped": 0}  # header, first record
+  {"type": "span", "path", "name", "thread", "seconds",
+   "device_wait_seconds": float|null, "attrs": {}}
+  {"type": "counter", "series", "value"}
+  {"type": "gauge", "series", "value"}
+  {"type": "histogram", "series", "count", "sum", "min", "max"}
+  {"type": "series", "name": "convergence", "fit", "coordinate",
+   "metric", "values": [float, ...]}
+  {"type": "report", "name": "pipeline"|"compile_cache", "data": {}}
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def _absorbed_reports() -> dict:
+    """The two pre-existing scalar surfaces the telemetry layer absorbs:
+    the ingest pipeline's per-stage report and the persistent compile
+    cache's hit/miss stats (None when unavailable)."""
+    out: dict = {}
+    try:
+        from photon_tpu.data.pipeline import PIPELINE_STATS
+
+        out["pipeline"] = PIPELINE_STATS.report()
+    except Exception:  # pragma: no cover — import cycles in odd embeds
+        out["pipeline"] = None
+    try:
+        from photon_tpu.utils.compile_cache import cache_stats
+
+        out["compile_cache"] = cache_stats()
+    except Exception:  # pragma: no cover
+        out["compile_cache"] = None
+    return out
+
+
+def snapshot() -> dict:
+    """Everything the telemetry layer knows, as one JSON-ready dict —
+    merged with the absorbed pipeline/compile-cache reports so one
+    snapshot answers the whole "where did the time go" question."""
+    from photon_tpu.obs import REGISTRY, convergence, enabled
+
+    from photon_tpu.obs import TRACER
+
+    out = {
+        "enabled": enabled(),
+        "spans": _spans_aggregated(),
+        "spans_dropped": TRACER.dropped,
+        "metrics": REGISTRY.snapshot(),
+        "convergence": convergence.snapshot(),
+    }
+    out.update(_absorbed_reports())
+    return out
+
+
+def _spans_aggregated() -> dict:
+    from photon_tpu.obs import TRACER
+    from photon_tpu.obs.spans import aggregate
+
+    return aggregate(TRACER.completed())
+
+
+def write_jsonl(path: str) -> int:
+    """Write the full telemetry stream; returns the line count."""
+    from photon_tpu.obs import TRACER, REGISTRY, convergence
+
+    lines: list[dict] = [{
+        "type": "telemetry",
+        "version": 1,
+        "spans_dropped": TRACER.dropped,
+    }]
+    for sp in TRACER.completed():
+        lines.append(sp.to_json())
+    m = REGISTRY.snapshot()
+    for series, value in sorted(m["counters"].items()):
+        lines.append({"type": "counter", "series": series, "value": value})
+    for series, value in sorted(m["gauges"].items()):
+        lines.append({"type": "gauge", "series": series, "value": value})
+    for series, h in sorted(m["histograms"].items()):
+        lines.append({"type": "histogram", "series": series, **h})
+    for fit_i, series in enumerate(convergence.traces()):
+        for cid, by_metric in series.items():
+            for metric, values in by_metric.items():
+                lines.append({
+                    "type": "series",
+                    "name": "convergence",
+                    "fit": fit_i,
+                    "coordinate": cid,
+                    "metric": metric,
+                    "values": values,
+                })
+    for name, data in _absorbed_reports().items():
+        if data is not None:
+            lines.append({"type": "report", "name": name, "data": data})
+    with open(path, "w") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+    return len(lines)
+
+
+_REQUIRED_KEYS = {
+    "telemetry": ("version",),
+    "span": ("path", "name", "thread", "seconds", "device_wait_seconds"),
+    "counter": ("series", "value"),
+    "gauge": ("series", "value"),
+    "histogram": ("series", "count", "sum", "min", "max"),
+    "series": ("name", "fit", "coordinate", "metric", "values"),
+    "report": ("name", "data"),
+}
+
+
+def validate_jsonl(path: str) -> int:
+    """Validate a telemetry JSONL file against the documented schema.
+
+    Raises ValueError on the first violation; returns the number of
+    validated lines. Shared by tests and the CI telemetry-smoke job —
+    the schema in OBSERVABILITY.md and this validator move together.
+    """
+    n = 0
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError as exc:
+                raise ValueError(f"{path}:{lineno}: not JSON ({exc})")
+            if not isinstance(rec, dict) or "type" not in rec:
+                raise ValueError(
+                    f"{path}:{lineno}: record without a 'type' field"
+                )
+            rtype = rec["type"]
+            if rtype not in _REQUIRED_KEYS:
+                raise ValueError(
+                    f"{path}:{lineno}: unknown record type {rtype!r}"
+                )
+            # The FIRST RECORD (not merely the first line — blank lines
+            # skip) must be the version header.
+            if n == 0 and rtype != "telemetry":
+                raise ValueError(
+                    f"{path}: first record must be the telemetry header"
+                )
+            missing = [
+                k for k in _REQUIRED_KEYS[rtype] if k not in rec
+            ]
+            if missing:
+                raise ValueError(
+                    f"{path}:{lineno}: {rtype} record missing "
+                    f"{', '.join(missing)}"
+                )
+            if rtype == "span" and rec["seconds"] < 0:
+                raise ValueError(
+                    f"{path}:{lineno}: negative span seconds"
+                )
+            if rtype == "series" and not isinstance(rec["values"], list):
+                raise ValueError(
+                    f"{path}:{lineno}: series values must be a list"
+                )
+            n += 1
+    if n == 0:
+        raise ValueError(f"{path}: empty telemetry file")
+    return n
+
+
+def summary_table() -> str:
+    """End-of-run text summary: the span tree + headline metrics."""
+    snap = snapshot()
+    rows = ["== telemetry summary ==", "-- spans (path, count, s, device-wait s) --"]
+    for path, agg in snap["spans"].items():
+        depth = path.count("/")
+        dw = agg["device_wait_seconds"]
+        rows.append(
+            f"  {'  ' * depth}{path.rsplit('/', 1)[-1]:<28} "
+            f"x{agg['count']:<4} {agg['seconds']:>10.4f} "
+            f"{'-' if dw is None else f'{dw:.4f}':>10}"
+        )
+    m = snap["metrics"]
+    if m["counters"]:
+        rows.append("-- counters --")
+        rows.extend(
+            f"  {k} = {v:g}" for k, v in sorted(m["counters"].items())
+        )
+    if m["gauges"]:
+        rows.append("-- gauges --")
+        rows.extend(
+            f"  {k} = {v:g}" for k, v in sorted(m["gauges"].items())
+        )
+    if m["histograms"]:
+        rows.append("-- histograms (count/sum/min/max) --")
+        rows.extend(
+            f"  {k}: n={h['count']} sum={h['sum']:.4f} "
+            f"min={h['min']:.4f} max={h['max']:.4f}"
+            for k, h in sorted(m["histograms"].items())
+        )
+    conv = snap["convergence"]
+    if conv["fits_recorded"]:
+        rows.append(
+            f"-- convergence: {conv['fits_recorded']} fit(s) recorded; "
+            f"metrics {', '.join(conv['metrics'])} --"
+        )
+    return "\n".join(rows)

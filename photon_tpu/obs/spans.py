@@ -1,0 +1,203 @@
+"""Hierarchical span tracer: one tree answering "where did the time go".
+
+The reference scatters runtime visibility across the Spark UI plus ad-hoc
+``Timed{}`` wall-clock logging (util/Timed.scala:33); our rebuild had
+grown the same scatter — ``utils/timed.py``, ``PIPELINE_STATS.stage``,
+per-update ``time.perf_counter()`` in the descent loops. This module is
+the one surface they all feed: thread-safe, hierarchical spans recording
+wall seconds and — at span ROOTS only — the host-vs-device split.
+
+Design constraints (the audited zero-overhead contract,
+``photon_tpu/obs/__init__.py`` PROGRAM_AUDIT):
+
+- **Nothing device-side.** Spans are pure host bookkeeping around
+  dispatch; no span ever appears inside a jitted program, so the traced
+  jaxprs are byte-identical with telemetry on or off.
+- **Device time only at roots.** A span constructed with ``sync=...`` (or
+  given ``span.sync = outputs`` before exit) calls
+  ``jax.block_until_ready`` ON EXIT and records the blocked wait as
+  ``device_wait_seconds``. Only coarse fit-level spans pass ``sync`` —
+  never per-iteration code — so telemetry adds at most one host sync per
+  fit, at a point the caller's first blocking read would have paid
+  anyway.
+- **Disabled == free.** With the tracer disabled, ``span()`` is a single
+  flag check yielding ``None``; no allocation, no lock, no sync.
+
+Hierarchy is per thread: each thread keeps its own span stack, and a
+span's ``path`` is its ancestors' names joined with ``/`` (worker-pool
+spans — the ingest planners, the background AOT compile — root their own
+subtrees, labeled by thread). Aggregation by path happens at export time
+(``obs/export.py``), so recording stays O(1) per span.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+
+# Retention bound on completed spans — the same concern that caps
+# convergence traces: a long telemetry-on production run (or the bench's
+# steady-state loop) must not grow host memory linearly. Oldest spans
+# drop first; the tracer counts drops so exporters can say so instead of
+# silently under-reporting.
+_MAX_SPANS = 4096
+
+
+class Span:
+    """One completed (or in-flight) timed section."""
+
+    __slots__ = (
+        "name",
+        "path",
+        "thread",
+        "t0",
+        "t1",
+        "seconds",
+        "device_wait_seconds",
+        "sync",
+        "attrs",
+    )
+
+    def __init__(self, name: str, path: str, thread: str):
+        self.name = name
+        self.path = path
+        self.thread = thread
+        self.t0 = 0.0
+        self.t1 = 0.0
+        self.seconds = 0.0
+        # Time spent blocked in jax.block_until_ready at span exit — the
+        # device-work tail the host had to wait out. None when the span
+        # carried no sync (host-only span).
+        self.device_wait_seconds: float | None = None
+        # Arrays (any pytree) to block on at exit; set via the ``sync=``
+        # kwarg or assigned inside the ``with`` body once outputs exist.
+        self.sync = None
+        self.attrs: dict | None = None
+
+    def to_json(self) -> dict:
+        return {
+            "type": "span",
+            "path": self.path,
+            "name": self.name,
+            "thread": self.thread,
+            "seconds": round(self.seconds, 6),
+            "device_wait_seconds": (
+                None
+                if self.device_wait_seconds is None
+                else round(self.device_wait_seconds, 6)
+            ),
+            "attrs": self.attrs or {},
+        }
+
+
+class SpanTracer:
+    """Thread-safe span recorder with per-thread hierarchy.
+
+    One process-global instance lives at ``photon_tpu.obs.TRACER``;
+    ``obs.enable()/disable()`` flip recording for the whole telemetry
+    layer (spans, convergence capture, metric side-feeds).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._spans: deque[Span] = deque(maxlen=_MAX_SPANS)
+        self.dropped = 0
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def completed(self) -> list[Span]:
+        """Snapshot of the completed spans (record order; bounded to the
+        most recent _MAX_SPANS — ``dropped`` counts the evicted)."""
+        with self._lock:
+            return list(self._spans)
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, sync=None, attrs: dict | None = None):
+        """Record a named section; yields the live Span (or None when
+        telemetry is disabled — callers must tolerate both).
+
+        ``sync``: pytree of jax arrays to ``block_until_ready`` at exit
+        (roots-only policy: pass it on fit-level spans, never inside
+        loops). The blocked time lands in ``device_wait_seconds``.
+        """
+        if not self.enabled:
+            yield None
+            return
+        stack = self._stack()
+        path = f"{stack[-1].path}/{name}" if stack else name
+        sp = Span(name, path, threading.current_thread().name)
+        if attrs:
+            sp.attrs = dict(attrs)
+        sp.sync = sync
+        stack.append(sp)
+        sp.t0 = time.perf_counter()
+        try:
+            yield sp
+        finally:
+            t1 = time.perf_counter()
+            try:
+                if sp.sync is not None:
+                    import jax
+
+                    # Clear before blocking: don't pin device arrays in
+                    # the record, and a raising sync (async device
+                    # failure surfacing here) must not leave them held.
+                    sync, sp.sync = sp.sync, None
+                    jax.block_until_ready(sync)
+                    t_done = time.perf_counter()
+                    sp.device_wait_seconds = t_done - t1
+                    t1 = t_done
+            finally:
+                # Pop + record UNCONDITIONALLY: if block_until_ready
+                # raised, the exception propagates, but the thread's
+                # span stack must not keep the dead span (every later
+                # span on this thread would inherit its path prefix).
+                sp.t1 = t1
+                sp.seconds = t1 - sp.t0
+                stack.pop()
+                with self._lock:
+                    if len(self._spans) == self._spans.maxlen:
+                        self.dropped += 1
+                    self._spans.append(sp)
+
+
+def aggregate(spans: list[Span]) -> dict[str, dict]:
+    """Path -> {count, seconds, device_wait_seconds} over completed spans.
+
+    The rendered "span tree": paths sort hierarchically, seconds are the
+    SUM over occurrences (a path entered from several threads or fits
+    accumulates), and ``device_wait_seconds`` sums only over occurrences
+    that carried a sync (None when none did).
+    """
+    out: dict[str, dict] = {}
+    for sp in spans:
+        agg = out.setdefault(
+            sp.path,
+            {"count": 0, "seconds": 0.0, "device_wait_seconds": None},
+        )
+        agg["count"] += 1
+        agg["seconds"] += sp.seconds
+        if sp.device_wait_seconds is not None:
+            agg["device_wait_seconds"] = (
+                agg["device_wait_seconds"] or 0.0
+            ) + sp.device_wait_seconds
+    for agg in out.values():
+        agg["seconds"] = round(agg["seconds"], 6)
+        if agg["device_wait_seconds"] is not None:
+            agg["device_wait_seconds"] = round(
+                agg["device_wait_seconds"], 6
+            )
+    return dict(sorted(out.items()))

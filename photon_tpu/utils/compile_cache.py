@@ -66,6 +66,20 @@ def _on_event(event: str, **kwargs) -> None:
     key = _EVENTS.get(event)
     if key is not None:
         _stats[key] += 1
+        # Side-feed the unified telemetry registry (photon_tpu.obs) so
+        # cache behavior shows up in the same snapshot/JSONL stream as
+        # spans and pipeline stages. Guarded: monitoring events can fire
+        # from compile paths during interpreter teardown.
+        try:
+            from photon_tpu import obs
+
+            if obs.enabled():
+                obs.REGISTRY.counter(
+                    "compile_cache_events_total",
+                    event=key.removeprefix("persistent_"),
+                ).inc()
+        except Exception:  # pragma: no cover — telemetry must never abort
+            pass
 
 
 def _install_listener() -> None:

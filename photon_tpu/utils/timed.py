@@ -1,9 +1,18 @@
 """Section timing + optional device profiling.
 
-TPU-native counterpart of photon-lib util/Timed.scala:33 — the
-``Timed("msg"){block}`` wall-clock section logger used pervasively by the
-reference's drivers and estimator — plus a ``jax.profiler.trace`` wrapper for
-real device traces (the capability the reference delegates to the Spark UI).
+.. deprecated::
+    ``Timed`` is a compatibility SHIM over the unified telemetry layer
+    (``photon_tpu.obs.span`` — see OBSERVABILITY.md): it keeps the
+    reference-parity logging contract ("<msg>: begin execution" /
+    "<msg>: executed in <t> s", util/Timed.scala:53-80) and the
+    ``.seconds`` attribute, but new code should open an ``obs.span``
+    directly — spans nest into one tree, carry the host/device split,
+    and export through the JSONL/snapshot surfaces. Direct ``Timed`` use
+    emits a :class:`DeprecationWarning` (hidden by default; visible
+    under ``-W error::DeprecationWarning``).
+
+``profile_trace`` remains the ``jax.profiler.trace`` wrapper for real
+device traces (the capability the reference delegates to the Spark UI).
 """
 
 from __future__ import annotations
@@ -11,6 +20,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
+import warnings
 
 logger = logging.getLogger("photon_tpu.timed")
 
@@ -22,21 +32,37 @@ class Timed:
     "<msg>: begin execution" then "<msg>: executed in <t> s". The elapsed
     time is exposed as ``.seconds`` for programmatic use (the reference's
     OptimizationStatesTracker timing role).
+
+    Deprecated shim: delegates to ``obs.logged_span`` — the ONE
+    logged-section helper — so the log format and span naming cannot
+    diverge between legacy call sites and migrated ones; this class only
+    adds the ``.seconds`` attribute on top.
     """
 
     def __init__(self, msg: str, log: logging.Logger | None = None):
+        warnings.warn(
+            "photon_tpu.utils.Timed is deprecated; use "
+            "photon_tpu.obs.logged_span (see OBSERVABILITY.md)",
+            DeprecationWarning,
+            stacklevel=2,
+        )
         self.msg = msg
         self.log = log or logger
         self.seconds = 0.0
+        self._cm = None
 
     def __enter__(self) -> "Timed":
-        self.log.info("%s: begin execution", self.msg)
+        from photon_tpu import obs
+
+        self._cm = obs.logged_span(self.msg, self.log)
+        self._cm.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.seconds = time.perf_counter() - self._t0
-        self.log.info("%s: executed in %.3f s", self.msg, self.seconds)
+        cm, self._cm = self._cm, None
+        cm.__exit__(exc_type, exc, tb)
 
 
 @contextlib.contextmanager

@@ -94,6 +94,33 @@ class TestTrainCLI:
         assert (model_dir / "random-effect" / "per-user" / "id-info").is_file()
         assert (model_dir / "checkpoint.npz").is_file()
 
+    def test_telemetry_flag_writes_schema_valid_jsonl(
+        self, tmp_path, glmix_avro, capsys
+    ):
+        """--telemetry PATH: the JSONL stream validates against the
+        documented schema, the snapshot rides training-summary.json, and
+        the process is left with telemetry disabled."""
+        from photon_tpu import obs
+        from photon_tpu.cli.train import main
+
+        train, val = glmix_avro
+        cfg_path, _ = _config(tmp_path, train, val)
+        t_path = tmp_path / "telemetry.jsonl"
+        assert main(["--config", str(cfg_path),
+                     "--telemetry", str(t_path)]) == 0
+        capsys.readouterr()
+        assert obs.validate_jsonl(str(t_path)) > 0
+        lines = [json.loads(l) for l in t_path.open()]
+        span_paths = {l["path"] for l in lines if l["type"] == "span"}
+        # The driver's section spans and the estimator's fit tree (this
+        # config has validation -> the unfused per-coordinate path).
+        assert "prepare training datasets" in span_paths
+        assert any("fit/config:0/coord:" in p for p in span_paths)
+        summary = json.loads(
+            (tmp_path / "out" / "training-summary.json").read_text())
+        assert summary["telemetry"]["spans"]
+        assert not obs.enabled()  # left as found
+
     def test_lambda_grid_selects_best(self, tmp_path, glmix_avro, capsys):
         from photon_tpu.cli.train import main
 

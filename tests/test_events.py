@@ -46,6 +46,48 @@ def test_listener_exception_propagates():
         emitter.send_event(PhotonEvent())
 
 
+def _raiser(e):
+    raise RuntimeError("listener boom")
+
+
+def test_safe_listeners_logs_and_continues(caplog):
+    """safe_listeners=True: one raising listener must not abort the
+    fan-out — the failure is logged, later listeners still run."""
+    got = []
+    emitter = EventEmitter(
+        [_raiser, got.append], safe_listeners=True
+    )
+    e = PhotonEvent()
+    import logging
+
+    with caplog.at_level(logging.ERROR, logger="photon_tpu.events"):
+        emitter.send_event(e)  # does not raise
+    assert got == [e]
+    assert any(
+        "listener" in r.getMessage() and "continuing" in r.getMessage()
+        for r in caplog.records
+    )
+
+
+def test_isolate_overrides_per_call():
+    """send_event(isolate=...) overrides the constructor default in
+    BOTH directions; the synchronous default semantics stay pinned."""
+    got = []
+    strict = EventEmitter([_raiser, got.append])  # default: propagate
+    with pytest.raises(RuntimeError, match="listener boom"):
+        strict.send_event(PhotonEvent())
+    assert got == []
+    strict.send_event(PhotonEvent(), isolate=True)
+    assert len(got) == 1
+
+    safe = EventEmitter([_raiser, got.append], safe_listeners=True)
+    safe.send_event(PhotonEvent())  # isolated by default
+    assert len(got) == 2
+    with pytest.raises(RuntimeError, match="listener boom"):
+        safe.send_event(PhotonEvent(), isolate=False)
+    assert len(got) == 2
+
+
 def test_estimator_emits_training_events(rng):
     n, d, e = 300, 5, 8
     x = rng.normal(size=(n, d)).astype(np.float64)

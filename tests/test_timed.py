@@ -1,0 +1,108 @@
+"""utils/timed.py: the deprecated ``Timed`` shim + ``profile_trace``.
+
+``Timed`` keeps the reference-parity logging contract (util/Timed.scala
+"begin execution" / "executed in") while delegating to the unified
+telemetry layer; ``profile_trace`` is the ``jax.profiler.trace`` wrapper
+whose None-directory no-op lets call sites wire it unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+import pytest
+
+from photon_tpu import obs
+from photon_tpu.utils.timed import Timed, profile_trace
+
+
+def _make_timed(msg, log=None):
+    with pytest.warns(DeprecationWarning, match="logged_span"):
+        return Timed(msg, log)
+
+
+def test_timed_keeps_logging_contract_and_seconds(caplog):
+    log = logging.getLogger("test.timed")
+    with caplog.at_level(logging.INFO, logger="test.timed"):
+        with _make_timed("section", log) as t:
+            time.sleep(0.01)
+    assert t.seconds >= 0.01
+    messages = [r.getMessage() for r in caplog.records]
+    assert "section: begin execution" in messages
+    assert any("section: executed in" in m for m in messages)
+
+
+def test_timed_records_span_when_telemetry_enabled():
+    was = obs.enabled()
+    obs.reset()
+    obs.enable()
+    try:
+        with _make_timed("legacy-section"):
+            pass
+        agg = obs.snapshot()["spans"]
+        # Same naming as obs.logged_span: legacy sections merge into the
+        # one span tree, no "timed:" silo.
+        assert "legacy-section" in agg
+        assert agg["legacy-section"]["count"] == 1
+    finally:
+        obs.TRACER.enabled = was
+        obs.reset()
+
+
+def test_timed_is_inert_when_telemetry_disabled():
+    was = obs.enabled()
+    obs.reset()
+    obs.disable()
+    try:
+        with _make_timed("quiet") as t:
+            pass
+        assert t.seconds >= 0.0
+        assert obs.TRACER.completed() == []
+    finally:
+        obs.TRACER.enabled = was
+
+
+def test_profile_trace_wraps_jax_profiler(monkeypatch):
+    """The jax.profiler.trace wrapper: a directory routes the block
+    through the profiler; None is a no-op that never touches jax."""
+    import jax
+
+    calls = []
+
+    @contextlib.contextmanager
+    def fake_trace(trace_dir):
+        calls.append(trace_dir)
+        yield
+
+    monkeypatch.setattr(jax.profiler, "trace", fake_trace)
+    ran = []
+    with profile_trace("/tmp/photon-prof"):
+        ran.append(True)
+    assert calls == ["/tmp/photon-prof"]
+    assert ran == [True]
+
+    with profile_trace(None):
+        ran.append(True)
+    with profile_trace(""):
+        ran.append(True)
+    assert calls == ["/tmp/photon-prof"]  # no-op paths never enter jax
+    assert len(ran) == 3
+
+
+def test_profile_trace_propagates_exceptions(monkeypatch):
+    import jax
+
+    entered = []
+
+    @contextlib.contextmanager
+    def fake_trace(trace_dir):
+        entered.append(trace_dir)
+        yield
+
+    monkeypatch.setattr(jax.profiler, "trace", fake_trace)
+    with pytest.raises(RuntimeError, match="boom"):
+        with profile_trace("/tmp/photon-prof"):
+            raise RuntimeError("boom")
+    assert entered == ["/tmp/photon-prof"]
