@@ -1,4 +1,4 @@
-"""photon_tpu.analysis — two static-analysis tiers that gate the package.
+"""photon_tpu.analysis — three static-analysis tiers that gate the package.
 
 Tier 1 is a pure-``ast`` lint pass (nothing analyzed is imported, no JAX
 needed at analysis time), so it runs in milliseconds on any machine. The
@@ -16,10 +16,18 @@ audited module declares (dispatch census, recompile-key stability,
 host-boundary and f64 audits, mesh sharding, and a static FLOP/HBM cost
 model for the roofline numbers bench.py compares against).
 
+Tier 3 (``--concurrency``; analysis/concurrency.py) audits the THREADED
+HOST RUNTIME: a pure-``ast`` lockset lint (Eraser-style) checked against
+the ``CONCURRENCY_AUDIT`` contracts the concurrent modules declare —
+unlocked writes to guarded state, blocking calls under a lock, AB/BA
+lock-order hazards, dropped futures, executor/thread hygiene, off-thread
+JAX dispatch without a declared reason, and stale contracts.
+
 Usage::
 
     python -m photon_tpu.analysis photon_tpu/            # tier-1 gate
     python -m photon_tpu.analysis --semantic             # tier-2 gate
+    python -m photon_tpu.analysis --concurrency          # tier-3 gate
     python -m photon_tpu.analysis --list-rules
     python -m photon_tpu.analysis --format json photon_tpu/data/
 

@@ -1,12 +1,16 @@
 """CLI: ``python -m photon_tpu.analysis [paths...]``.
 
-Two tiers share this entry point:
+Three tiers share this entry point:
 
 - default: the tier-1 pure-``ast`` lint pass over source files;
 - ``--semantic``: the tier-2 program auditor (analysis/program.py) —
   traces the package's jitted entry points under abstract shapes and
   audits jaxprs/HLO against the modules' declared contracts. Needs JAX
   (CPU is fine; no device execution) but no accelerator.
+- ``--concurrency``: the tier-3 host-concurrency auditor
+  (analysis/concurrency.py) — a pure-``ast`` lockset lint over source
+  files, checked against the ``CONCURRENCY_AUDIT`` contracts the
+  threaded modules declare. No JAX, no imports of the audited code.
 
 Exit codes: 0 clean (or only suppressed findings), 1 unsuppressed
 findings, 2 usage error.
@@ -70,6 +74,13 @@ def main(argv: list[str] | None = None) -> int:
         "instead of the source lint",
     )
     parser.add_argument(
+        "--concurrency",
+        action="store_true",
+        help="run the tier-3 host-concurrency auditor (lockset lint "
+        "against CONCURRENCY_AUDIT contracts) instead of the source "
+        "lint",
+    )
+    parser.add_argument(
         "--cost-out",
         metavar="PATH",
         help="with --semantic: also write the per-program cost-model/"
@@ -78,12 +89,33 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        print(render_rule_list())
+        if args.concurrency:
+            from photon_tpu.analysis import concurrency
+
+            print(concurrency.render_rule_list())
+        else:
+            print(render_rule_list())
         return 0
 
+    if args.semantic and args.concurrency:
+        print(
+            "--semantic and --concurrency are separate tiers; run "
+            "them as separate invocations",
+            file=sys.stderr,
+        )
+        return 2
     if args.cost_out and not args.semantic:
         print("--cost-out requires --semantic", file=sys.stderr)
         return 2
+    if args.concurrency:
+        if args.select:
+            print(
+                "--select applies to the tier-1 rules; the concurrency "
+                "tier always runs its full rule set",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_concurrency(args)
     if args.semantic:
         if args.paths or args.select:
             print(
@@ -108,24 +140,12 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
             return 2
-    missing = [p for p in paths if not Path(p).exists()]
-    if missing:
-        print(
-            f"no such path(s): {', '.join(missing)}", file=sys.stderr
-        )
+    if _paths_usage_error(paths):
         return 2
     try:
         findings = analyze_paths(paths, select=select)
     except OSError as exc:
         print(str(exc), file=sys.stderr)
-        return 2
-    if not any(iter_python_files(paths)):
-        # A gate that analyzed zero files must not report "clean" — a
-        # wrong CWD or glob would make CI pass vacuously.
-        print(
-            "no Python files found under: " + ", ".join(map(str, paths)),
-            file=sys.stderr,
-        )
         return 2
 
     if args.format == "json":
@@ -134,6 +154,47 @@ def main(argv: list[str] | None = None) -> int:
         out = render_text(findings, show_suppressed=args.show_suppressed)
         if out:
             print(out)
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+def _paths_usage_error(paths) -> bool:
+    """Shared tier-1/tier-3 path validation: a gate that analyzed zero
+    files must not report "clean" — a wrong CWD, typo, or empty glob
+    exits 2, never 0."""
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(
+            f"no such path(s): {', '.join(missing)}", file=sys.stderr
+        )
+        return True
+    if not any(iter_python_files(paths)):
+        print(
+            "no Python files found under: " + ", ".join(map(str, paths)),
+            file=sys.stderr,
+        )
+        return True
+    return False
+
+
+def _run_concurrency(args) -> int:
+    from photon_tpu.analysis import concurrency
+
+    paths = args.paths or ["photon_tpu"]
+    if _paths_usage_error(paths):
+        return 2
+    findings = concurrency.audit_paths(paths)
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        out = render_text(findings, show_suppressed=args.show_suppressed)
+        if out:
+            print(out)
+        contracts = concurrency.collect_contracts(paths)
+        for name, c in sorted(contracts.items()):
+            locks = ", ".join(
+                f"{lk}->({', '.join(v)})" for lk, v in c.locks.items()
+            )
+            print(f"contract {name}: {locks or 'no locks declared'}")
     return 1 if any(not f.suppressed for f in findings) else 0
 
 

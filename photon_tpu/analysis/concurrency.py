@@ -1,0 +1,1064 @@
+"""Tier-3 semantic auditor: host-concurrency contracts for photon_tpu.
+
+Tier 1 reads source text and tier 2 reads traced programs; this tier
+audits the THREADED HOST RUNTIME that PRs 3 and 4 introduced — the
+ingest plan/chunk pools and the background AOT-compile thread
+(``data/pipeline.py``), the per-estimator priming pool
+(``estimators/game_estimator.py``), and the lock-guarded telemetry and
+event state (``obs/``, ``events.py``, ``utils/compile_cache.py``). The
+runtime hammer tests are weak race detectors on a 2-core CI box; this
+pass is the static complement, in the spirit of Eraser's lockset
+algorithm: every module declares a ``CONCURRENCY_AUDIT`` contract naming
+its locks, the state each lock guards, and its thread-entry points, and
+the auditor checks the discipline purely from the AST — no imports of
+the audited code, no execution, no JAX.
+
+Rules (the registry is ``CONCURRENCY_RULES``):
+
+- ``unlocked-shared-write`` — a write (assignment, augmented assignment,
+  or mutating method call, including through a local alias) to state the
+  contract declares lock-guarded, outside a ``with <lock>:`` scope.
+  ``__init__`` bodies and module top level are exempt (pre-publication).
+- ``blocking-under-lock`` — a blocking operation while a lock is held:
+  ``jax.block_until_ready`` / ``jax.device_put`` / ``np.asarray`` (a
+  potential device fetch), ``Future.result()``, ``open()``,
+  ``time.sleep``, executor ``shutdown``, a no-arg ``.join()``, or an XLA
+  ``.compile()``. Everything queued behind the lock inherits the wait.
+- ``lock-order-hazard`` — two locks acquired in inconsistent nesting
+  order in different places in the module (the classic AB/BA deadlock).
+- ``dropped-future`` — an ``executor.submit(...)`` whose Future is
+  discarded (bare statement) or bound to a name that is never used: the
+  thunk's exception can never be observed.
+- ``thread-hygiene`` — a ``ThreadPoolExecutor`` without a bounded
+  ``max_workers``, an executor that is neither context-managed nor ever
+  ``shutdown`` in the module, or a non-daemon ``threading.Thread`` the
+  module never joins.
+- ``jax-dispatch-off-thread`` — a jit/trace/compile entry (``jax.jit``,
+  ``.trace``/``.lower``/``.compile``, ``aot_compile``,
+  ``jax.block_until_ready``, ``jax.device_put``) inside a callable the
+  module hands to an executor or thread, unless the contract's
+  ``jax_dispatch_ok`` declares that entry safe with a written reason.
+- ``concurrency-contract`` — contract integrity: modules that create
+  locks/threads/executors must declare a contract; declared locks,
+  guarded state, thread entries, and ``jax_dispatch_ok`` names must all
+  still exist (stale declarations are findings); locks created but not
+  declared are findings; ``jax_dispatch_ok`` entries need a reason.
+
+Contract schema (plain data next to the code it constrains, mirroring
+``PROGRAM_AUDIT``; parsed from the AST, never imported)::
+
+    CONCURRENCY_AUDIT = dict(
+        name="obs-metrics",
+        locks={
+            # lock -> the state it guards. "Class._attr" for instance
+            # state, a bare name for module globals.
+            "MetricsRegistry._lock": (
+                "MetricsRegistry._counters",
+                "MetricsRegistry._gauges",
+                "MetricsRegistry._histograms",
+            ),
+        },
+        thread_entries=("_Counter.inc",),  # runs on non-main threads
+        jax_dispatch_ok={},                # entry -> why it is safe
+    )
+
+Suppressions are the tier-1 per-line mechanism unchanged
+(``# photon: ignore[rule] -- reason``); findings reuse
+:class:`photon_tpu.analysis.core.Finding`, so the text/JSON reporters
+work as-is. Known limits (documented, fixture-tested where they bite):
+lock identity is by terminal attribute name within one module — sound
+because ``concurrency-contract`` FLAGS ambiguous terminals (two locks
+both named ``_lock``) instead of silently mismatching; the write check
+is intraprocedural; and a thunk reaching a pool through a variable
+(``pool.submit(t) for t in thunks``) is not traced to its definition.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from photon_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    iter_python_files,
+)
+
+CONCURRENCY_RULES: dict[str, str] = {
+    "unlocked-shared-write": (
+        "write to contract-declared lock-guarded state outside a "
+        "`with <lock>` scope"
+    ),
+    "blocking-under-lock": (
+        "blocking call (device sync/transfer, Future.result, file I/O, "
+        "sleep, shutdown, compile) while holding a lock"
+    ),
+    "lock-order-hazard": (
+        "two locks acquired in inconsistent nesting order across the "
+        "module (AB/BA deadlock shape)"
+    ),
+    "dropped-future": (
+        "executor.submit(...) whose Future is discarded — the thunk's "
+        "exception can never be observed"
+    ),
+    "thread-hygiene": (
+        "unbounded or never-shut-down executor, or a non-daemon thread "
+        "the module never joins"
+    ),
+    "jax-dispatch-off-thread": (
+        "jit/trace/compile entry inside a submitted thunk without a "
+        "declared jax_dispatch_ok reason"
+    ),
+    "concurrency-contract": (
+        "CONCURRENCY_AUDIT missing or stale (declared lock/state/entry "
+        "no longer exists, or created lock undeclared)"
+    ),
+}
+
+_LOCK_FACTORIES = frozenset(
+    {
+        "threading.Lock",
+        "threading.RLock",
+        "threading.Condition",
+        "threading.Semaphore",
+        "threading.BoundedSemaphore",
+    }
+)
+_EXECUTOR_FACTORIES = frozenset(
+    {
+        "concurrent.futures.ThreadPoolExecutor",
+        "concurrent.futures.thread.ThreadPoolExecutor",
+        "concurrent.futures.ProcessPoolExecutor",
+    }
+)
+_THREAD_FACTORIES = frozenset({"threading.Thread", "threading.Timer"})
+
+# Mutating container methods: a call through a guarded name (or an alias
+# of one) counts as a write for the lockset check.
+_MUTATORS = frozenset(
+    {
+        "append",
+        "appendleft",
+        "extend",
+        "insert",
+        "remove",
+        "pop",
+        "popleft",
+        "popitem",
+        "clear",
+        "update",
+        "setdefault",
+        "add",
+        "discard",
+        "move_to_end",
+    }
+)
+
+_BLOCKING_PATHS = {
+    "jax.block_until_ready": "jax.block_until_ready (device sync)",
+    "jax.device_put": "jax.device_put (host->device transfer)",
+    "jax.device_get": "jax.device_get (device->host transfer)",
+    "numpy.asarray": "np.asarray (device fetch if the value lives on "
+    "device, large copy otherwise)",
+    "numpy.array": "np.array (device fetch if the value lives on "
+    "device, large copy otherwise)",
+    "time.sleep": "time.sleep",
+    "concurrent.futures.wait": "concurrent.futures.wait",
+}
+# Attribute calls that block regardless of what object they hang off
+# (matched when the dotted path does not resolve to an import).
+_BLOCKING_ATTRS = {
+    "result": "Future.result() (blocks until the thunk finishes)",
+    "block_until_ready": "block_until_ready (device sync)",
+    "shutdown": "executor shutdown (waits for queued work by default)",
+    "compile": "XLA compile (seconds of wall-clock)",
+}
+
+_JAX_ENTRY_PATHS = frozenset(
+    {
+        "jax.jit",
+        "jax.pjit",
+        "jax.eval_shape",
+        "jax.vmap",
+        "jax.grad",
+        "jax.value_and_grad",
+        "jax.block_until_ready",
+        "jax.device_put",
+    }
+)
+_JAX_ENTRY_ATTRS = frozenset({"trace", "lower", "compile"})
+
+
+# --------------------------------------------------------------------------
+# contract parsing (pure AST — the audited module is never imported)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ConcurrencyContract:
+    """One module's declared concurrency model."""
+
+    name: str
+    locks: dict[str, tuple[str, ...]]  # lock -> guarded state names
+    thread_entries: tuple[str, ...] = ()
+    jax_dispatch_ok: dict[str, str] = dataclasses.field(
+        default_factory=dict
+    )
+    line: int = 0
+
+    def guarded(self) -> dict[str, str]:
+        """Terminal guarded-state name -> terminal lock name."""
+        out: dict[str, str] = {}
+        for lock, states in self.locks.items():
+            for s in states:
+                out[_terminal(s)] = _terminal(lock)
+        return out
+
+
+def _terminal(name: str) -> str:
+    return name.split(".")[-1]
+
+
+class _ContractError(ValueError):
+    pass
+
+
+def _literal(node: ast.AST):
+    """Evaluate the restricted literal forms a contract may use:
+    constants, dict/list/tuple/set displays, and ``dict(...)`` calls."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Dict):
+        return {
+            _literal(k): _literal(v)
+            for k, v in zip(node.keys, node.values)
+        }
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        out = [_literal(e) for e in node.elts]
+        return set(out) if isinstance(node, ast.Set) else tuple(out)
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "dict"
+        and not node.args
+    ):
+        return {kw.arg: _literal(kw.value) for kw in node.keywords}
+    raise _ContractError(
+        f"unsupported expression in CONCURRENCY_AUDIT at line "
+        f"{getattr(node, 'lineno', '?')}: {ast.dump(node)[:60]}"
+    )
+
+
+def parse_contract(
+    tree: ast.Module,
+) -> tuple[ConcurrencyContract | None, str | None]:
+    """The module's CONCURRENCY_AUDIT declaration, or (None, error)."""
+    for node in tree.body:
+        targets = (
+            node.targets
+            if isinstance(node, ast.Assign)
+            else [node.target]
+            if isinstance(node, ast.AnnAssign) and node.value
+            else []
+        )
+        if not any(
+            isinstance(t, ast.Name) and t.id == "CONCURRENCY_AUDIT"
+            for t in targets
+        ):
+            continue
+        try:
+            raw = _literal(node.value)
+            if not isinstance(raw, dict):
+                raise _ContractError("CONCURRENCY_AUDIT must be a dict")
+            name = raw.get("name")
+            if not isinstance(name, str) or not name:
+                raise _ContractError("contract needs a non-empty `name`")
+            locks = {
+                str(k): tuple(str(s) for s in v)
+                for k, v in dict(raw.get("locks") or {}).items()
+            }
+            return (
+                ConcurrencyContract(
+                    name=name,
+                    locks=locks,
+                    thread_entries=tuple(
+                        str(t) for t in raw.get("thread_entries") or ()
+                    ),
+                    jax_dispatch_ok={
+                        str(k): str(v)
+                        for k, v in dict(
+                            raw.get("jax_dispatch_ok") or {}
+                        ).items()
+                    },
+                    line=node.lineno,
+                ),
+                None,
+            )
+        except _ContractError as exc:
+            return None, str(exc)
+    return None, None
+
+
+# --------------------------------------------------------------------------
+# module model
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ModuleModel:
+    """Everything the rules need, extracted in one walk."""
+
+    ctx: ModuleContext
+    contract: ConcurrencyContract | None
+    contract_error: str | None
+    # qualified lock name ("Class._lock" / "_lock") -> creation node
+    lock_defs: dict[str, ast.AST]
+    executor_calls: list[ast.Call]
+    thread_calls: list[ast.Call]
+    submit_calls: list[ast.Call]
+    # every def/lambda in the module by terminal name (methods included)
+    defs: dict[str, ast.AST]
+    has_shutdown_call: bool
+    has_join_call: bool
+
+    @property
+    def lock_terminals(self) -> frozenset[str]:
+        names = {_terminal(n) for n in self.lock_defs}
+        if self.contract:
+            names.update(_terminal(n) for n in self.contract.locks)
+        return frozenset(names)
+
+
+def _enclosing_class(ctx: ModuleContext, node: ast.AST) -> str | None:
+    for anc in ctx.parent_chain(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc.name
+        if isinstance(anc, ast.Module):
+            return None
+    return None
+
+
+def build_model(ctx: ModuleContext) -> ModuleModel:
+    contract, err = parse_contract(ctx.tree)
+    lock_defs: dict[str, ast.AST] = {}
+    executor_calls: list[ast.Call] = []
+    thread_calls: list[ast.Call] = []
+    submit_calls: list[ast.Call] = []
+    defs: dict[str, ast.AST] = {}
+    has_shutdown = has_join = False
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+        elif isinstance(node, ast.Call):
+            path = ctx.resolve(node.func)
+            if path in _LOCK_FACTORIES:
+                parent = ctx.parents.get(node)
+                target = None
+                if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+                    tgts = (
+                        parent.targets
+                        if isinstance(parent, ast.Assign)
+                        else [parent.target]
+                    )
+                    for t in tgts:
+                        if isinstance(t, ast.Attribute):
+                            cls = _enclosing_class(ctx, node)
+                            target = (
+                                f"{cls}.{t.attr}" if cls else t.attr
+                            )
+                        elif isinstance(t, ast.Name):
+                            cls = _enclosing_class(ctx, node)
+                            target = (
+                                f"{cls}.{t.id}" if cls else t.id
+                            )
+                lock_defs[target or f"<anonymous@{node.lineno}>"] = node
+            elif path in _EXECUTOR_FACTORIES:
+                executor_calls.append(node)
+            elif path in _THREAD_FACTORIES:
+                thread_calls.append(node)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "submit"
+                and ctx.resolve(node.func) is None
+            ):
+                submit_calls.append(node)
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "shutdown":
+                    has_shutdown = True
+                elif node.func.attr == "join" and not node.args:
+                    has_join = True
+    return ModuleModel(
+        ctx=ctx,
+        contract=contract,
+        contract_error=err,
+        lock_defs=lock_defs,
+        executor_calls=executor_calls,
+        thread_calls=thread_calls,
+        submit_calls=submit_calls,
+        defs=defs,
+        has_shutdown_call=has_shutdown,
+        has_join_call=has_join,
+    )
+
+
+# --------------------------------------------------------------------------
+# lock-scope helpers
+# --------------------------------------------------------------------------
+
+
+def _lock_expr_terminal(expr: ast.AST) -> str | None:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def held_locks(model: ModuleModel, node: ast.AST) -> list[str]:
+    """Terminal names of module locks held at ``node`` (lexically:
+    the ``with`` statements on the ancestor chain whose context
+    expression names a known lock), outermost first."""
+    held: list[str] = []
+    for anc in model.ctx.parent_chain(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                t = _lock_expr_terminal(item.context_expr)
+                if t is not None and t in model.lock_terminals:
+                    held.append(t)
+    held.reverse()
+    return held
+
+
+def _finding(
+    ctx: ModuleContext, rule_id: str, node: ast.AST, message: str
+) -> Finding:
+    return Finding(
+        rule=rule_id,
+        path=ctx.path,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        message=message,
+    )
+
+
+# --------------------------------------------------------------------------
+# rule: unlocked-shared-write
+# --------------------------------------------------------------------------
+
+
+def _guarded_aliases(
+    model: ModuleModel, func: ast.AST, guarded: dict[str, str]
+) -> dict[str, str]:
+    """Local names assigned directly from a guarded attribute/global
+    inside ``func`` — writes through them count as writes to the state."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        src = node.value
+        name = None
+        if isinstance(src, ast.Attribute):
+            name = src.attr
+        elif isinstance(src, ast.Name):
+            name = src.id
+        if name in guarded:
+            aliases[tgt.id] = name
+    return aliases
+
+
+def _write_targets(node: ast.AST) -> Iterator[ast.AST]:
+    """The target expressions a statement writes to (flattening tuple
+    unpacking), or the base of a mutating method call."""
+    if isinstance(node, ast.Assign):
+        stack = list(node.targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            else:
+                yield t
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        yield node.target
+    elif (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _MUTATORS
+    ):
+        yield node.func.value
+
+
+def _written_name(target: ast.AST) -> str | None:
+    """Terminal state name a write target refers to: ``x._attr``,
+    bare ``name``, or a subscript on either."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    if isinstance(target, ast.Name):
+        return target.id
+    return None
+
+
+def check_unlocked_shared_write(model: ModuleModel) -> Iterator[Finding]:
+    if model.contract is None or not model.contract.locks:
+        return
+    guarded = model.contract.guarded()
+    ctx = model.ctx
+    alias_cache: dict[ast.AST, dict[str, str]] = {}
+    for node in ast.walk(ctx.tree):
+        for target in _write_targets(node):
+            name = _written_name(target)
+            if name is None:
+                continue
+            func = ctx.enclosing_function(node)
+            if func is None:
+                continue  # import-time initialization, pre-threads
+            if getattr(func, "name", "") == "__init__":
+                continue  # the object is not yet published
+            state = None
+            if name in guarded and (
+                isinstance(
+                    target.value
+                    if isinstance(target, ast.Subscript)
+                    else target,
+                    ast.Attribute,
+                )
+                or _is_module_global(guarded, name, model)
+            ):
+                state = name
+            else:
+                if func not in alias_cache:
+                    alias_cache[func] = _guarded_aliases(
+                        model, func, guarded
+                    )
+                state = alias_cache[func].get(name)
+                if state is not None and not isinstance(
+                    target, (ast.Subscript,)
+                ) and not (
+                    isinstance(node, ast.Call)
+                ):
+                    # Rebinding the alias itself is not a shared write.
+                    state = None
+            if state is None:
+                continue
+            want = guarded[state]
+            if want in held_locks(model, node):
+                continue
+            yield _finding(
+                ctx,
+                "unlocked-shared-write",
+                node,
+                f"write to `{state}` (declared guarded by `{want}` in "
+                f"CONCURRENCY_AUDIT) outside a `with {want}` scope",
+            )
+
+
+def _is_module_global(
+    guarded: dict[str, str], name: str, model: ModuleModel
+) -> bool:
+    """True when the contract declares ``name`` as a bare module-level
+    global (no class qualifier) — a bare Name write then counts."""
+    if model.contract is None:
+        return False
+    for states in model.contract.locks.values():
+        for s in states:
+            if s == name and "." not in s:
+                return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# rule: blocking-under-lock
+# --------------------------------------------------------------------------
+
+
+def check_blocking_under_lock(model: ModuleModel) -> Iterator[Finding]:
+    ctx = model.ctx
+    if not model.lock_terminals:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        held = held_locks(model, node)
+        if not held:
+            continue
+        path = ctx.resolve(node.func)
+        why = None
+        if path in _BLOCKING_PATHS:
+            why = _BLOCKING_PATHS[path]
+        elif isinstance(node.func, ast.Name) and node.func.id == "open":
+            why = "open() (file I/O)"
+        elif isinstance(node.func, ast.Attribute) and path is None:
+            attr = node.func.attr
+            if attr == "join" and node.args:
+                pass  # str.join(iterable) — not a thread join
+            elif attr in _BLOCKING_ATTRS:
+                why = _BLOCKING_ATTRS[attr]
+        if why is None:
+            continue
+        yield _finding(
+            ctx,
+            "blocking-under-lock",
+            node,
+            f"{why} while holding `{held[-1]}`: every thread queued on "
+            "the lock inherits this wait; move the blocking call "
+            "outside the critical section",
+        )
+
+
+# --------------------------------------------------------------------------
+# rule: lock-order-hazard
+# --------------------------------------------------------------------------
+
+
+def check_lock_order(model: ModuleModel) -> Iterator[Finding]:
+    ctx = model.ctx
+    if len(model.lock_terminals) < 2:
+        return
+    # (outer, inner) -> first acquisition site exhibiting that order
+    orders: dict[tuple[str, str], ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        inner = [
+            t
+            for item in node.items
+            if (t := _lock_expr_terminal(item.context_expr)) is not None
+            and t in model.lock_terminals
+        ]
+        if not inner:
+            continue
+        outer = held_locks(model, node)
+        for o in outer:
+            for i in inner:
+                if o != i:
+                    orders.setdefault((o, i), node)
+    reported: set[frozenset[str]] = set()
+    for (o, i), node in sorted(
+        orders.items(), key=lambda kv: kv[1].lineno
+    ):
+        if (i, o) in orders and frozenset((o, i)) not in reported:
+            reported.add(frozenset((o, i)))
+            other = orders[(i, o)]
+            yield _finding(
+                ctx,
+                "lock-order-hazard",
+                node,
+                f"locks `{o}` and `{i}` are acquired in both orders "
+                f"(here `{o}`->`{i}`; line {other.lineno} takes "
+                f"`{i}`->`{o}`): two threads taking opposite orders "
+                "deadlock; pick one global order",
+            )
+
+
+# --------------------------------------------------------------------------
+# rule: dropped-future
+# --------------------------------------------------------------------------
+
+
+def check_dropped_future(model: ModuleModel) -> Iterator[Finding]:
+    ctx = model.ctx
+    for call in model.submit_calls:
+        parent = ctx.parents.get(call)
+        if isinstance(parent, ast.Expr):
+            yield _finding(
+                ctx,
+                "dropped-future",
+                call,
+                "submit(...) as a bare statement: the Future (and any "
+                "exception the thunk raises) is dropped on the floor; "
+                "keep it and consume .result()",
+            )
+            continue
+        if (
+            isinstance(parent, ast.Assign)
+            and len(parent.targets) == 1
+            and isinstance(parent.targets[0], ast.Name)
+        ):
+            name = parent.targets[0].id
+            func = ctx.enclosing_function(call) or ctx.tree
+            used = any(
+                isinstance(n, ast.Name)
+                and n.id == name
+                and isinstance(n.ctx, ast.Load)
+                for n in ast.walk(func)
+            )
+            if not used:
+                yield _finding(
+                    ctx,
+                    "dropped-future",
+                    call,
+                    f"Future bound to `{name}` is never consumed: the "
+                    "thunk's exception can never be observed; call "
+                    ".result() (or .exception()) on every submitted "
+                    "Future",
+                )
+
+
+# --------------------------------------------------------------------------
+# rule: thread-hygiene
+# --------------------------------------------------------------------------
+
+
+def _kw(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def check_thread_hygiene(model: ModuleModel) -> Iterator[Finding]:
+    ctx = model.ctx
+    for call in model.executor_calls:
+        if _kw(call, "max_workers") is None and not call.args:
+            yield _finding(
+                ctx,
+                "thread-hygiene",
+                call,
+                "executor without a bounded max_workers: the default "
+                "scales with the host's cores and oversubscribes the "
+                "2-core CI box; pass an explicit bound",
+            )
+        parent = ctx.parents.get(call)
+        managed = isinstance(parent, ast.withitem)
+        if not managed and not model.has_shutdown_call:
+            yield _finding(
+                ctx,
+                "thread-hygiene",
+                call,
+                "executor is neither context-managed nor ever shut "
+                "down in this module: worker threads (and queued "
+                "thunks) outlive every error path; use `with` or "
+                "guarantee shutdown() in a finally",
+            )
+    for call in model.thread_calls:
+        daemon = _kw(call, "daemon")
+        is_daemon = (
+            isinstance(daemon, ast.Constant) and daemon.value is True
+        )
+        if not is_daemon and not model.has_join_call:
+            yield _finding(
+                ctx,
+                "thread-hygiene",
+                call,
+                "non-daemon Thread that this module never joins: the "
+                "process cannot exit while it runs and its exceptions "
+                "vanish; join it or mark daemon=True deliberately",
+            )
+
+
+# --------------------------------------------------------------------------
+# rule: jax-dispatch-off-thread
+# --------------------------------------------------------------------------
+
+
+def _submitted_callables(
+    model: ModuleModel,
+) -> Iterator[tuple[str, ast.AST, ast.AST]]:
+    """(name, body-node, submit-site) for every callable the module
+    hands to an executor/thread that the AST can link to a definition."""
+    ctx = model.ctx
+    seen: set[ast.AST] = set()
+
+    def emit(name: str, node: ast.AST, site: ast.AST):
+        if node not in seen:
+            seen.add(node)
+            yield (name, node, site)
+
+    for call in model.submit_calls:
+        if not call.args:
+            continue
+        target = call.args[0]
+        if isinstance(target, ast.Lambda):
+            yield from emit("<lambda>", target, call)
+        elif isinstance(target, ast.Name):
+            fn = model.defs.get(target.id)
+            if fn is not None:
+                yield from emit(target.id, fn, call)
+        elif isinstance(target, ast.Attribute):
+            if ctx.resolve(target) is None:  # not an imported callable
+                fn = model.defs.get(target.attr)
+                if fn is not None:
+                    yield from emit(target.attr, fn, call)
+    for call in model.thread_calls:
+        target = _kw(call, "target")
+        if isinstance(target, ast.Lambda):
+            yield from emit("<lambda>", target, call)
+        elif isinstance(target, ast.Name):
+            fn = model.defs.get(target.id)
+            if fn is not None:
+                yield from emit(target.id, fn, call)
+    if model.contract:
+        for entry in model.contract.thread_entries:
+            fn = model.defs.get(_terminal(entry))
+            if fn is not None:
+                yield from emit(_terminal(entry), fn, fn)
+
+
+def check_jax_dispatch_off_thread(
+    model: ModuleModel,
+) -> Iterator[Finding]:
+    ctx = model.ctx
+    waived = (
+        {_terminal(k) for k in model.contract.jax_dispatch_ok}
+        if model.contract
+        else set()
+    )
+    for name, body, _site in _submitted_callables(model):
+        if name in waived:
+            continue
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            path = ctx.resolve(node.func)
+            hit = None
+            if path in _JAX_ENTRY_PATHS:
+                hit = path
+            elif path is not None and path.endswith("aot_compile"):
+                hit = path
+            elif (
+                path is None
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _JAX_ENTRY_ATTRS
+            ):
+                hit = f".{node.func.attr}()"
+            if hit is None:
+                continue
+            yield _finding(
+                ctx,
+                "jax-dispatch-off-thread",
+                node,
+                f"`{hit}` inside thread-entry `{name}`: jit/trace "
+                "entry off the main thread can interleave trace "
+                "contexts and rendezvous; declare it safe in "
+                "CONCURRENCY_AUDIT jax_dispatch_ok with a reason, or "
+                "move the dispatch to the caller",
+            )
+
+
+# --------------------------------------------------------------------------
+# rule: concurrency-contract (integrity + staleness)
+# --------------------------------------------------------------------------
+
+
+def _module_mentions(model: ModuleModel, terminal: str) -> bool:
+    """Whether ``terminal`` appears as an attribute or bare name
+    anywhere in the module (the existence proxy for declared state)."""
+    for node in ast.walk(model.ctx.tree):
+        if isinstance(node, ast.Attribute) and node.attr == terminal:
+            return True
+        if isinstance(node, ast.Name) and node.id == terminal:
+            return True
+    return False
+
+
+def check_contract(model: ModuleModel) -> Iterator[Finding]:
+    ctx = model.ctx
+    if model.contract_error:
+        yield _finding(
+            ctx,
+            "concurrency-contract",
+            ctx.tree,
+            f"CONCURRENCY_AUDIT does not parse: {model.contract_error}",
+        )
+        return
+    machinery = (
+        list(model.lock_defs.values())
+        + model.executor_calls
+        + model.thread_calls
+    )
+    if model.contract is None:
+        if machinery:
+            first = min(machinery, key=lambda n: n.lineno)
+            yield _finding(
+                ctx,
+                "concurrency-contract",
+                first,
+                "module creates locks/threads/executors but declares "
+                "no CONCURRENCY_AUDIT contract: name the locks, the "
+                "state each guards, and the thread entries",
+            )
+        return
+    c = model.contract
+    anchor = _ContractAnchor(ctx, c.line)
+    # Ambiguous lock naming breaks the auditor's own identity model
+    # (locks are matched by terminal name within a module): two locks
+    # both named `_lock` would silently disable the lock-order check
+    # and let a write under the WRONG lock satisfy the lockset. Enforce
+    # distinct terminals rather than documenting the hole.
+    by_terminal: dict[str, list[str]] = {}
+    for qual in list(model.lock_defs) + list(c.locks):
+        if qual.startswith("<anonymous"):
+            continue
+        by_terminal.setdefault(_terminal(qual), []).append(qual)
+    for terminal, quals in sorted(by_terminal.items()):
+        distinct = sorted(set(quals))
+        if len(distinct) > 1:
+            yield _finding(
+                ctx,
+                "concurrency-contract",
+                anchor,
+                f"locks {', '.join(f'`{q}`' for q in distinct)} share "
+                f"the terminal name `{terminal}`: the auditor matches "
+                "locks by terminal name within a module, so ambiguous "
+                "naming disables the lock-order check and weakens the "
+                "lockset; rename for distinct terminals",
+            )
+    created_terminals = {_terminal(n) for n in model.lock_defs}
+    for lock in c.locks:
+        if _terminal(lock) not in created_terminals:
+            yield _finding(
+                ctx,
+                "concurrency-contract",
+                anchor,
+                f"declared lock `{lock}` is never created in this "
+                "module — the contract went stale",
+            )
+    for lock, states in c.locks.items():
+        for s in states:
+            if not _module_mentions(model, _terminal(s)):
+                yield _finding(
+                    ctx,
+                    "concurrency-contract",
+                    anchor,
+                    f"declared guarded state `{s}` (under `{lock}`) "
+                    "does not exist in this module — the contract "
+                    "went stale",
+                )
+    for lock_name, node in model.lock_defs.items():
+        if lock_name.startswith("<anonymous"):
+            continue
+        if not any(
+            _terminal(lock_name) == _terminal(d) for d in c.locks
+        ):
+            yield _finding(
+                ctx,
+                "concurrency-contract",
+                node,
+                f"lock `{lock_name}` is created here but not declared "
+                "in CONCURRENCY_AUDIT.locks — declare what it guards",
+            )
+    for entry in c.thread_entries:
+        if model.defs.get(_terminal(entry)) is None:
+            yield _finding(
+                ctx,
+                "concurrency-contract",
+                anchor,
+                f"declared thread entry `{entry}` does not exist in "
+                "this module — the contract went stale",
+            )
+    for entry, reason in c.jax_dispatch_ok.items():
+        if model.defs.get(_terminal(entry)) is None:
+            yield _finding(
+                ctx,
+                "concurrency-contract",
+                anchor,
+                f"jax_dispatch_ok entry `{entry}` does not exist in "
+                "this module — the contract went stale",
+            )
+        if not reason.strip():
+            yield _finding(
+                ctx,
+                "concurrency-contract",
+                anchor,
+                f"jax_dispatch_ok entry `{entry}` has no reason — the "
+                "waiver is part of the contract and must say why the "
+                "off-thread dispatch is safe",
+            )
+
+
+class _ContractAnchor:
+    """Anchors contract-level findings to the declaration line so the
+    per-line suppression mechanism applies to them too."""
+
+    def __init__(self, ctx: ModuleContext, line: int):
+        self.lineno = line or 1
+        self.col_offset = 0
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+_CHECKS = (
+    check_contract,
+    check_unlocked_shared_write,
+    check_blocking_under_lock,
+    check_lock_order,
+    check_dropped_future,
+    check_thread_hygiene,
+    check_jax_dispatch_off_thread,
+)
+
+
+def audit_source(source: str, path: str = "<string>") -> list[Finding]:
+    """All tier-3 findings for one source blob, suppressions applied."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                rule="syntax-error",
+                path=path,
+                line=exc.lineno or 1,
+                col=(exc.offset or 1) - 1,
+                message=f"file does not parse: {exc.msg}",
+            )
+        ]
+    ctx = ModuleContext(path, source, tree)
+    model = build_model(ctx)
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    for check in _CHECKS:
+        for f in check(model):
+            key = (f.rule, f.line, f.col, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            sup = ctx.suppressions.get(f.line)
+            if sup is not None and sup.covers(f.rule):
+                f = dataclasses.replace(
+                    f, suppressed=True, suppress_reason=sup.reason
+                )
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def audit_file(path: str | Path) -> list[Finding]:
+    p = Path(path)
+    return audit_source(p.read_text(encoding="utf-8"), path=str(p))
+
+
+def audit_paths(paths: Iterable[str | Path]) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(audit_file(f))
+    return findings
+
+
+def collect_contracts(
+    paths: Iterable[str | Path],
+) -> dict[str, ConcurrencyContract]:
+    """Contract name -> declaration, for reports and tests."""
+    out: dict[str, ConcurrencyContract] = {}
+    for f in iter_python_files(paths):
+        contract, _ = parse_contract(
+            ast.parse(f.read_text(encoding="utf-8"), filename=str(f))
+        )
+        if contract is not None:
+            out[contract.name] = contract
+    return out
+
+
+def render_rule_list() -> str:
+    width = max(len(r) for r in CONCURRENCY_RULES)
+    return "\n".join(
+        f"{rid.ljust(width)}  {summary}"
+        for rid, summary in CONCURRENCY_RULES.items()
+    )

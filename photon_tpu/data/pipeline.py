@@ -35,12 +35,15 @@ in-line path (the determinism property tests diff the two);
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 # Program contract (audited by `python -m photon_tpu.analysis --semantic`;
 # machinery in analysis/program.py): the ingest pipeline's AOT warm-compile
@@ -56,6 +59,37 @@ PROGRAM_AUDIT = dict(
     max_programs=2,
     stable_under=("aot_warm_compile",),
     hot_loop=True,
+)
+
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`; machinery in analysis/concurrency.py). The threading
+# model: `_Pool._lock` guards lazy pool construction/teardown;
+# `PipelineStats._stats_lock` guards every accounting map plus the
+# generation counter (worker threads in all three pools write stages
+# concurrently with the training thread's reset). The two locks carry
+# DISTINCT terminal names on purpose: the auditor identifies locks by
+# terminal name within a module (and flags ambiguity), which is what
+# keeps its lock-order and lockset checks sound here. Chunk thunks
+# (`map_chunked.run`) are pure numpy over disjoint row spans — no JAX
+# dispatch off-thread here; the AOT compile thread's dispatch is
+# declared (with its reason) in game_estimator's contract, next to
+# `_warm_compile` itself. `_concat_cache` is deliberately NOT
+# lock-guarded: it is written only from the single thread that runs
+# `packed_device_put`, and the worst case of a future race is one
+# duplicate jit wrapper, never corruption.
+CONCURRENCY_AUDIT = dict(
+    name="ingest-pipeline",
+    locks={
+        "_Pool._lock": ("_Pool._pool",),
+        "PipelineStats._stats_lock": (
+            "PipelineStats._generation",
+            "PipelineStats._seconds",
+            "PipelineStats._spans",
+            "PipelineStats._counts",
+        ),
+    },
+    thread_entries=("map_chunked.run",),
+    jax_dispatch_ok={},
 )
 
 
@@ -143,10 +177,51 @@ compile_executor = _Pool("photon-compile", 2)
 
 
 def reset_executors() -> None:
-    """Drop pools so the next use re-reads the env (tests)."""
-    plan_executor.shutdown()
-    chunk_executor.shutdown()
-    compile_executor.shutdown()
+    """Drop pools so the next use re-reads the env (tests).
+
+    Nested try/finally: a shutdown that raises (an interpreter tearing
+    down, a worker's late exception surfacing in join) must still shut
+    the remaining pools down — leaking the chunk or compile pool after
+    a failed plan-pool shutdown strands daemon-less workers."""
+    try:
+        plan_executor.shutdown()
+    finally:
+        try:
+            chunk_executor.shutdown()
+        finally:
+            compile_executor.shutdown()
+
+
+def consume_futures(futs) -> list:
+    """``[f.result() for f in futs]`` that consumes EVERY future.
+
+    The naive loop abandons the remaining futures on the first raising
+    ``result()`` — their thunks keep running and any exception they
+    raise is silently swallowed (the auditor's ``dropped-future`` class,
+    in its dynamic form). Here every future is awaited; the FIRST
+    exception propagates (matching the naive loop's contract) after the
+    rest completed, and later exceptions are logged so no failure is
+    invisible."""
+    results: list = []
+    first_exc: Exception | None = None
+    for f in futs:
+        try:
+            results.append(f.result())
+        # Exception, NOT BaseException: a main-thread KeyboardInterrupt
+        # or SystemExit delivered while blocked in result() must abort
+        # the wait immediately — deferring it until every remaining
+        # thunk completes could hold the interrupt for minutes.
+        except Exception as exc:  # noqa: BLE001 — re-raised below
+            if first_exc is None:
+                first_exc = exc
+            else:
+                logger.warning(
+                    "additional worker-thunk failure (first is being "
+                    "re-raised): %r", exc,
+                )
+    if first_exc is not None:
+        raise first_exc
+    return results
 
 
 class PipelineStats:
@@ -158,7 +233,7 @@ class PipelineStats:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
         self._generation = 0
         self.reset()
 
@@ -173,7 +248,7 @@ class PipelineStats:
         transfer recorded at ``make_game_dataset`` time, which happens
         before any estimator exists).
         """
-        with self._lock:
+        with self._stats_lock:
             kept_s = {
                 k: v
                 for k, v in getattr(self, "_seconds", {}).items()
@@ -203,7 +278,7 @@ class PipelineStats:
         # authoritative either way.
         from photon_tpu import obs
 
-        with self._lock:
+        with self._stats_lock:
             gen = self._generation
         t0 = time.perf_counter()
         try:
@@ -211,7 +286,7 @@ class PipelineStats:
                 yield
         finally:
             t1 = time.perf_counter()
-            with self._lock:
+            with self._stats_lock:
                 # A stale generation token (reset() ran mid-stage, e.g.
                 # an orphaned background compile) records nothing — it
                 # must not pollute the new generation's report. The
@@ -236,12 +311,12 @@ class PipelineStats:
                         span[1] = max(span[1], t1)
 
     def add(self, name: str, seconds: float) -> None:
-        with self._lock:
+        with self._stats_lock:
             self._seconds[name] = self._seconds.get(name, 0.0) + seconds
             self._counts[name] = self._counts.get(name, 0) + 1
 
     def seconds(self, name: str) -> float:
-        with self._lock:
+        with self._stats_lock:
             return self._seconds.get(name, 0.0)
 
     def report(self) -> dict:
@@ -252,7 +327,7 @@ class PipelineStats:
         BLOCKED waiting for it, over the duration — 1.0 means the compile
         hid entirely under ingest + operand assembly, 0.0 means it was
         paid serially after all (and None means no warm compile ran)."""
-        with self._lock:
+        with self._stats_lock:
             seconds = dict(self._seconds)
             spans = {k: tuple(v) for k, v in self._spans.items()}
         compile_s = seconds.get("compile", 0.0)
@@ -311,12 +386,12 @@ def map_chunked(fn, out: np.ndarray, *arrays: np.ndarray) -> np.ndarray:
     def run(lo: int, hi: int) -> None:
         out[lo:hi] = fn(*[a[lo:hi] for a in arrays])
 
-    futs = [
-        chunk_executor.submit(run, lo, hi)
-        for lo, hi in _chunk_bounds(n, workers)
-    ]
-    for f in futs:
-        f.result()
+    consume_futures(
+        [
+            chunk_executor.submit(run, lo, hi)
+            for lo, hi in _chunk_bounds(n, workers)
+        ]
+    )
     return out
 
 
@@ -327,15 +402,17 @@ def bincount_chunked(codes: np.ndarray, minlength: int) -> np.ndarray:
     workers = ingest_threads()
     if serial_ingest() or workers <= 1 or n < _CHUNK_MIN_ROWS:
         return np.bincount(codes, minlength=minlength)
-    futs = [
-        chunk_executor.submit(
-            np.bincount, codes[lo:hi], minlength=minlength
-        )
-        for lo, hi in _chunk_bounds(n, workers)
-    ]
-    total = futs[0].result().astype(np.int64, copy=True)
-    for f in futs[1:]:
-        total += f.result()
+    parts = consume_futures(
+        [
+            chunk_executor.submit(
+                np.bincount, codes[lo:hi], minlength=minlength
+            )
+            for lo, hi in _chunk_bounds(n, workers)
+        ]
+    )
+    total = parts[0].astype(np.int64, copy=True)
+    for p in parts[1:]:
+        total += p
     return total
 
 

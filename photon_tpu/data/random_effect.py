@@ -62,6 +62,7 @@ from photon_tpu.data.pipeline import (
     PIPELINE_STATS,
     bincount_chunked,
     chunk_executor,
+    consume_futures,
     map_chunked,
     packed_device_put,
 )
@@ -1618,13 +1619,14 @@ def build_random_effect_dataset(
         )
 
     with PIPELINE_STATS.stage("pack"):
-        bucket_host = [
-            f.result()
-            for f in [
+        # consume_futures: every bucket thunk's exception is observed
+        # even when an earlier bucket already failed.
+        bucket_host = consume_futures(
+            [
                 chunk_executor.submit(_build_bucket, cap)
                 for cap in sorted(plan.bucket_members)
             ]
-        ]
+        )
 
     covered_np = np.zeros(plan.codes.shape[0], dtype=bool)
     for bh in bucket_host:

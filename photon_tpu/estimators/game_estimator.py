@@ -78,6 +78,39 @@ _FUSED_CACHE_SIZE = 8
 # second pins the unfused coordinate update (_run_impl under jit): λ and
 # warm-start coefficients are traced operands, so one executable serves
 # the entire grid.
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`). The estimator owns no locks: all mutable estimator
+# state (_fit_cache, _fused_cache, _aot_future, _primed_datasets) is
+# written by the single training thread only. What it DOES own is
+# thread entries — per-coordinate planners on the ingest plan pool
+# (`build_one`), the background AOT warm compile on the compile pool
+# (`_warm_compile`), and the compile-priming thunks (`thunk` inside
+# `warmup_thunks`; the ModelCoordinate lambda in `_prime_compilations`
+# is the same shape) — and the declared reasons why the JAX entries on
+# those threads are safe. Results always come back to the training
+# thread through Futures (every one consumed — see consume_futures).
+CONCURRENCY_AUDIT = dict(
+    name="game-estimator-host",
+    locks={},
+    thread_entries=(
+        "_build_datasets.build_one",
+        "_warm_compile",
+        "warmup_thunks.thunk",
+    ),
+    jax_dispatch_ok={
+        "_warm_compile": "XLA compiles in C++ with the GIL released — "
+        "that release IS the overlap win; the traced skeletons are "
+        "thread-private, the persistent compile cache is thread-safe "
+        "in JAX, and FusedFit.run serializes consumption through the "
+        "future (compile_wait measures any residual block)",
+        "warmup_thunks.thunk": "priming executes real warm-up solves "
+        "concurrently BY DESIGN (the compiler handles concurrent "
+        "requests ~2.5x faster); single-device only — the mesh path "
+        "returns before submitting because collective rendezvous must "
+        "not interleave (see _prime_compilations docstring)",
+    },
+)
+
 PROGRAM_AUDIT = [
     dict(
         name="fused-cache-key",
@@ -202,6 +235,18 @@ class GameFitResult:
     config: dict[str, GLMOptimizationConfiguration]
     evaluation: EvaluationResults | None
     descent: CoordinateDescentResult
+
+
+def _log_orphaned_compile(fut) -> None:
+    """Done-callback consuming an orphaned warm-compile future (a
+    prepare() superseded it mid-compile): the result is discarded by
+    design, but an exception must be seen, not dropped."""
+    exc = fut.exception()
+    if exc is not None:
+        logger.warning(
+            "orphaned AOT warm compile raised after being superseded "
+            "(should be impossible — _warm_compile catches): %r", exc,
+        )
 
 
 class GameEstimator:
@@ -357,9 +402,15 @@ class GameEstimator:
             for cid, cfg in self.coordinate_configs.items()
             if isinstance(cfg, RandomEffectCoordinateConfiguration)
         }
+        # consume_futures: every planner's exception is observed even
+        # when an earlier coordinate's build already failed (the naive
+        # per-future .result() loop abandons — and silences — the rest).
+        planned = dict(
+            zip(futs, pipeline.consume_futures(futs.values()))
+        )
         out = {
             cid: (
-                futs[cid].result() if cid in futs else build_one(cid, cfg)
+                planned[cid] if cid in planned else build_one(cid, cfg)
             )
             for cid, cfg in self.coordinate_configs.items()
         }
@@ -549,9 +600,13 @@ class GameEstimator:
                 thunks.extend(coord.warmup_thunks())
         if len(thunks) < 2:
             return
+        from photon_tpu.data.pipeline import consume_futures
+
         with ThreadPoolExecutor(max_workers=min(8, len(thunks))) as pool:
-            for f in [pool.submit(t) for t in thunks]:
-                f.result()
+            # consume_futures: a thunk that fails after another already
+            # raised must still be awaited and its exception surfaced —
+            # the pool's __exit__ would otherwise swallow it silently.
+            consume_futures([pool.submit(t) for t in thunks])
         self._primed_datasets = datasets
 
     def _fused_for(self, coords, datasets):
@@ -800,8 +855,13 @@ class GameEstimator:
         from photon_tpu.data import pipeline
 
         stale = getattr(self, "_aot_future", None)
-        if stale is not None:
-            stale.cancel()
+        if stale is not None and not stale.cancel():
+            # Already running: the compile finishes in the background
+            # (its stage write is discarded by the generation token).
+            # Consume the orphaned future so its outcome is never
+            # dropped — _warm_compile is internally exception-safe, so
+            # a late exception here means that safety net broke.
+            stale.add_done_callback(_log_orphaned_compile)
         pipeline.PIPELINE_STATS.reset(keep=("raw_transfer",))
         self._aot_future = None
         if self._warm_compile_eligible(validation, initial_model):

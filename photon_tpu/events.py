@@ -20,9 +20,27 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 from typing import Any, Callable
 
 logger = logging.getLogger(__name__)
+
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`). Telemetry sinks register/unregister listeners from
+# whatever thread owns them while the training thread fans out events,
+# and a listener may mutate the registry from INSIDE the fan-out (a
+# one-shot progress listener removing itself). The listener list is
+# lock-guarded and `send_event` iterates a SNAPSHOT taken under the
+# lock: every listener registered when the emit began receives the
+# event exactly once, regardless of concurrent (or reentrant) mutation,
+# and the listener calls themselves run outside the lock so a reentrant
+# add/remove cannot deadlock.
+CONCURRENCY_AUDIT = dict(
+    name="event-bus",
+    locks={"EventEmitter._lock": ("EventEmitter._listeners",)},
+    thread_entries=(),
+    jax_dispatch_ok={},
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,28 +107,40 @@ class EventEmitter:
     """
 
     def __init__(self, listeners=None, *, safe_listeners: bool = False):
+        self._lock = threading.Lock()
         self._listeners: list[Listener] = list(listeners or ())
         self.safe_listeners = safe_listeners
 
     def add_listener(self, listener: Listener) -> None:
-        self._listeners.append(listener)
+        with self._lock:
+            self._listeners.append(listener)
 
     def remove_listener(self, listener: Listener) -> None:
-        self._listeners.remove(listener)
+        with self._lock:
+            self._listeners.remove(listener)
 
     def clear_listeners(self) -> None:
-        self._listeners.clear()
+        with self._lock:
+            self._listeners.clear()
 
     def send_event(
         self, event: PhotonEvent, *, isolate: bool | None = None
     ) -> None:
         if isolate is None:
             isolate = self.safe_listeners
+        # Snapshot under the lock; fan out OUTSIDE it. A listener that
+        # mutates the registry mid-emit (removing itself, adding a
+        # sibling) must neither skip the next listener (the classic
+        # mutate-during-iteration bug) nor deadlock on a reentrant
+        # add/remove. Listeners added during the fan-out see the NEXT
+        # event; listeners present at emit start all see this one.
+        with self._lock:
+            listeners = tuple(self._listeners)
         if not isolate:
-            for listener in self._listeners:
+            for listener in listeners:
                 listener(event)
             return
-        for listener in self._listeners:
+        for listener in listeners:
             try:
                 listener(event)
             except Exception:  # noqa: BLE001 — isolation is the contract

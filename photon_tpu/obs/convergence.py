@@ -43,6 +43,23 @@ METRICS = (
 # device buffer would pin HBM for telemetry nobody reads.
 _MAX_TRACES = 8
 
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`). `record()` runs on the training thread while
+# exporters materialize traces from any thread; the parked-trace deque
+# and the fit counter share the module lock. The device->host fetch in
+# `_series` runs OUTSIDE the lock on purpose (a transfer under the lock
+# would block `record()` for its duration — the `blocking-under-lock`
+# rule's canonical case) with a double-checked swap installing the
+# cached numpy array under the lock.
+CONCURRENCY_AUDIT = dict(
+    name="obs-convergence",
+    locks={
+        "_lock": ("_traces", "_fits_recorded"),
+    },
+    thread_entries=(),
+    jax_dispatch_ok={},
+)
+
 _lock = threading.Lock()
 _traces: deque = deque(maxlen=_MAX_TRACES)
 _fits_recorded = 0

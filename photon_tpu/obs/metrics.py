@@ -18,6 +18,27 @@ from __future__ import annotations
 
 import threading
 
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`). Every mutation path — counters from the event bus,
+# histograms from pipeline stage exits on worker threads, gauges from
+# exporters — funnels through the one registry lock; the handle classes
+# (`_Counter.inc` / `_Gauge.set` / `_Histogram.observe`) are the
+# thread-entry surface because pipeline and compile threads call them
+# directly. The hammer test in tests/test_obs.py is the runtime
+# counterpart (no lost updates under a thread pool).
+CONCURRENCY_AUDIT = dict(
+    name="obs-metrics",
+    locks={
+        "MetricsRegistry._lock": (
+            "MetricsRegistry._counters",
+            "MetricsRegistry._gauges",
+            "MetricsRegistry._histograms",
+        ),
+    },
+    thread_entries=("_Counter.inc", "_Gauge.set", "_Histogram.observe"),
+    jax_dispatch_ok={},
+)
+
 
 def _series_key(name: str, labels: dict) -> str:
     if not labels:

@@ -44,6 +44,26 @@ from collections import deque
 # silently under-reporting.
 _MAX_SPANS = 4096
 
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`). Worker threads (ingest planners, the AOT compile
+# thread) record spans concurrently with the training thread, so the
+# completed-span deque and the drop counter live under one lock; the
+# per-thread span STACKS are `threading.local` and need none. The
+# `enabled` flag is deliberately unguarded: it is a benign latch read
+# once per span entry, and a racing enable/disable can only gain or
+# lose one span at the boundary, never corrupt the record.
+CONCURRENCY_AUDIT = dict(
+    name="obs-spans",
+    locks={
+        "SpanTracer._lock": (
+            "SpanTracer._spans",
+            "SpanTracer.dropped",
+        ),
+    },
+    thread_entries=(),
+    jax_dispatch_ok={},
+)
+
 
 class Span:
     """One completed (or in-flight) timed section."""

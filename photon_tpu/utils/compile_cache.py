@@ -17,10 +17,38 @@ hit).
 from __future__ import annotations
 
 import os
+import threading
 
 _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "photon_tpu_xla"
 )
+
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`). The counters here are written from whatever thread
+# happens to compile: `_on_event` fires from JAX's monitoring hooks
+# during any compile (including the ingest pipeline's background
+# AOT-compile thread), and `aot_compile` itself runs ON that thread —
+# concurrent with the training thread's jit fallbacks. Before this
+# contract the dict updates were bare `+=` on a module global (torn
+# read-modify-write under free threading, lost updates under the GIL's
+# ~5ms switch interval); every write now takes the module lock. The
+# XLA compile in `aot_compile` runs OUTSIDE the lock (minutes-long on
+# real programs — the `blocking-under-lock` rule's worst case).
+CONCURRENCY_AUDIT = dict(
+    name="compile-cache",
+    locks={
+        "_lock": ("_stats", "_listener_installed", "_dir_in_effect"),
+    },
+    thread_entries=("_on_event", "aot_compile"),
+    jax_dispatch_ok={
+        "aot_compile": "the whole point of the entry: XLA compiles in "
+        "C++ with the GIL released on the pipeline's dedicated compile "
+        "thread; the Lowered it compiles is thread-private and the "
+        "persistent-cache singleton is thread-safe in JAX",
+    },
+)
+
+_lock = threading.Lock()
 
 # Monitoring event -> counter key. Misses are recorded by
 # jax/_src/compilation_cache.py on a failed lookup; hits by
@@ -57,18 +85,22 @@ def aot_compile(lowered):
 
     t0 = time.perf_counter()
     compiled = lowered.compile()
-    _stats["aot_compiles"] += 1
-    _stats["aot_compile_seconds"] += time.perf_counter() - t0
+    seconds = time.perf_counter() - t0
+    with _lock:
+        _stats["aot_compiles"] += 1
+        _stats["aot_compile_seconds"] += seconds
     return compiled
 
 
 def _on_event(event: str, **kwargs) -> None:
     key = _EVENTS.get(event)
     if key is not None:
-        _stats[key] += 1
+        with _lock:
+            _stats[key] += 1
         # Side-feed the unified telemetry registry (photon_tpu.obs) so
         # cache behavior shows up in the same snapshot/JSONL stream as
-        # spans and pipeline stages. Guarded: monitoring events can fire
+        # spans and pipeline stages (outside the module lock — the
+        # registry takes its own). Guarded: monitoring events can fire
         # from compile paths during interpreter teardown.
         try:
             from photon_tpu import obs
@@ -84,14 +116,17 @@ def _on_event(event: str, **kwargs) -> None:
 
 def _install_listener() -> None:
     global _listener_installed
-    if _listener_installed:
-        return
-    import jax.monitoring
+    with _lock:
+        if _listener_installed:
+            return
+        import jax.monitoring
 
-    # Listeners are append-only in jax (no unregister API); one
-    # process-lifetime counter hook is the intended use.
-    jax.monitoring.register_event_listener(_on_event)
-    _listener_installed = True
+        # Listeners are append-only in jax (no unregister API); one
+        # process-lifetime counter hook is the intended use. Latched
+        # under the lock so two racing enable calls cannot register
+        # the listener (and double-count every event) twice.
+        jax.monitoring.register_event_listener(_on_event)
+        _listener_installed = True
 
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
@@ -114,7 +149,8 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         # dir=None while the counters keep climbing.
         jax.config.update("jax_compilation_cache_dir", None)
         _reset_cache_singleton()
-        _dir_in_effect = None
+        with _lock:
+            _dir_in_effect = None
         return None
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # Cache everything that took meaningful compile time; the default
@@ -128,7 +164,8 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     # configured above actually takes effect.
     _reset_cache_singleton()
     _install_listener()
-    _dir_in_effect = cache_dir
+    with _lock:
+        _dir_in_effect = cache_dir
     return cache_dir
 
 
@@ -163,19 +200,22 @@ def cache_stats() -> dict:
     ``entries``/``bytes`` pair is the directory scan at call time — a
     cross-process view of what the next cold start will find.
     """
-    hits = _stats["persistent_hits"]
-    misses = _stats["persistent_misses"]
+    with _lock:
+        snap = dict(_stats)
+        cache_dir = _dir_in_effect
+    hits = snap["persistent_hits"]
+    misses = snap["persistent_misses"]
     total = hits + misses
-    entries, size = (
-        _dir_stats(_dir_in_effect) if _dir_in_effect else (0, 0)
-    )
+    # The directory scan stays outside the lock: it is filesystem I/O
+    # and must not stall a compile thread's counter update.
+    entries, size = _dir_stats(cache_dir) if cache_dir else (0, 0)
     return {
-        "dir": _dir_in_effect,
+        "dir": cache_dir,
         "persistent_hits": hits,
         "persistent_misses": misses,
         "hit_rate": (hits / total) if total else None,
         "entries": entries,
         "bytes": size,
-        "aot_compiles": _stats["aot_compiles"],
-        "aot_compile_seconds": round(_stats["aot_compile_seconds"], 4),
+        "aot_compiles": snap["aot_compiles"],
+        "aot_compile_seconds": round(snap["aot_compile_seconds"], 4),
     }

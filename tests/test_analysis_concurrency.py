@@ -1,0 +1,501 @@
+"""Tier-3 concurrency auditor: per-rule fixtures, contracts, the gate.
+
+Layout mirrors the other analysis suites: every rule gets a DELIBERATELY
+VIOLATING fixture the auditor must flag (and a clean variant it must
+not), the contract machinery is pinned (parsing, staleness, waivers,
+suppressions with reasons), and the repo-wide gate runs the real
+``--concurrency`` CLI and fails on any unsuppressed finding.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from photon_tpu.analysis import concurrency
+from photon_tpu.analysis.__main__ import main as cli_main
+
+PACKAGE = Path(__import__("photon_tpu").__file__).parent
+
+
+def rules_of(src: str) -> list[str]:
+    return [
+        f.rule for f in concurrency.audit_source(src) if not f.suppressed
+    ]
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: each violating shape is flagged, each clean twin is not
+# ---------------------------------------------------------------------------
+
+
+def test_unlocked_shared_write_instance_state():
+    src = """
+import threading
+CONCURRENCY_AUDIT = dict(name="m", locks={"R._lock": ("R._counts",)})
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+    def bad(self, k):
+        self._counts[k] = 1
+    def good(self, k):
+        with self._lock:
+            self._counts[k] = 1
+"""
+    findings = concurrency.audit_source(src)
+    bad = [f for f in findings if f.rule == "unlocked-shared-write"]
+    assert len(bad) == 1 and bad[0].line == 9
+    # __init__ (pre-publication) and the locked write are both clean.
+
+
+def test_unlocked_shared_write_module_global_and_alias():
+    src = """
+import threading
+CONCURRENCY_AUDIT = dict(name="m", locks={"_lock": ("_n", "_items")})
+_lock = threading.Lock()
+_n = 0
+_items = []
+def bad():
+    global _n
+    _n += 1
+    _items.append(2)
+def bad_alias():
+    x = _items
+    x.append(3)
+def good():
+    global _n
+    with _lock:
+        _n += 1
+        _items.append(2)
+def good_alias_rebind():
+    x = _items
+    x = []
+"""
+    lines = sorted(
+        f.line
+        for f in concurrency.audit_source(src)
+        if f.rule == "unlocked-shared-write"
+    )
+    # the two bare-global writes plus the mutation through the alias;
+    # rebinding the alias itself is NOT a shared write.
+    assert lines == [9, 10, 13]
+
+
+def test_unlocked_write_through_other_object_attribute():
+    """The metrics.py shape: a handle class writing the registry's
+    guarded dict through `self.registry._counters` — matched by the
+    terminal attribute name, locked through the registry's own lock."""
+    src = """
+import threading
+CONCURRENCY_AUDIT = dict(
+    name="m", locks={"Reg._lock": ("Reg._counters",)})
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+class Handle:
+    def __init__(self, registry):
+        self.registry = registry
+    def inc(self):
+        with self.registry._lock:
+            c = self.registry._counters
+            c["k"] = c.get("k", 0) + 1
+    def bad_inc(self):
+        self.registry._counters["k"] = 1
+"""
+    bad = [
+        f
+        for f in concurrency.audit_source(src)
+        if f.rule == "unlocked-shared-write"
+    ]
+    assert len(bad) == 1 and bad[0].line == 17
+
+
+def test_blocking_under_lock():
+    src = """
+import threading
+import jax
+import numpy as np
+CONCURRENCY_AUDIT = dict(name="m", locks={"_lock": ("_x",)})
+_lock = threading.Lock()
+_x = None
+def bad(fut, dev):
+    global _x
+    with _lock:
+        _x = fut.result()
+        jax.block_until_ready(dev)
+        y = np.asarray(dev)
+        f = open("/tmp/x")
+def good(fut):
+    global _x
+    r = fut.result()
+    y = ", ".join(["a"])  # str.join is not a thread join
+    with _lock:
+        _x = r
+"""
+    lines = sorted(
+        f.line
+        for f in concurrency.audit_source(src)
+        if f.rule == "blocking-under-lock"
+    )
+    assert lines == [11, 12, 13, 14]
+
+
+def test_lock_order_hazard():
+    src = """
+import threading
+CONCURRENCY_AUDIT = dict(name="m", locks={"_a": ("_x",), "_b": ("_y",)})
+_a = threading.Lock()
+_b = threading.Lock()
+_x = _y = None
+def f():
+    with _a:
+        with _b:
+            pass
+def g():
+    with _b:
+        with _a:
+            pass
+"""
+    hits = [
+        f
+        for f in concurrency.audit_source(src)
+        if f.rule == "lock-order-hazard"
+    ]
+    assert len(hits) == 1  # one finding per inconsistent pair
+    assert "_a" in hits[0].message and "_b" in hits[0].message
+
+
+def test_lock_order_consistent_is_clean():
+    src = """
+import threading
+CONCURRENCY_AUDIT = dict(name="m", locks={"_a": ("_x",), "_b": ("_y",)})
+_a = threading.Lock()
+_b = threading.Lock()
+_x = _y = None
+def f():
+    with _a:
+        with _b:
+            pass
+def g():
+    with _a:
+        with _b:
+            pass
+"""
+    assert "lock-order-hazard" not in rules_of(src)
+
+
+def test_dropped_future():
+    src = """
+CONCURRENCY_AUDIT = dict(name="m", locks={})
+def fire_and_forget(pool, t):
+    pool.submit(t)
+def bound_never_used(pool, t):
+    fut = pool.submit(t)
+def consumed(pool, t):
+    fut = pool.submit(t)
+    return fut.result()
+def stored(pool, t, sink):
+    sink.append(pool.submit(t))
+"""
+    lines = sorted(
+        f.line
+        for f in concurrency.audit_source(src)
+        if f.rule == "dropped-future"
+    )
+    assert lines == [4, 6]
+
+
+def test_thread_hygiene():
+    src = """
+import threading
+from concurrent.futures import ThreadPoolExecutor
+CONCURRENCY_AUDIT = dict(name="m", locks={})
+def bad():
+    ex = ThreadPoolExecutor()
+    t = threading.Thread(target=bad)
+    t.start()
+def good():
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        pass
+    t = threading.Thread(target=bad, daemon=True)
+    t.start()
+"""
+    hits = [
+        f
+        for f in concurrency.audit_source(src)
+        if f.rule == "thread-hygiene"
+    ]
+    # unbounded max_workers + never shut down + never-joined thread
+    assert sorted(f.line for f in hits) == [6, 6, 7]
+
+
+def test_thread_hygiene_shutdown_elsewhere_is_clean():
+    src = """
+from concurrent.futures import ThreadPoolExecutor
+CONCURRENCY_AUDIT = dict(name="m", locks={})
+class Pool:
+    def start(self):
+        self._pool = ThreadPoolExecutor(max_workers=2)
+    def stop(self):
+        self._pool.shutdown(wait=True)
+"""
+    assert "thread-hygiene" not in rules_of(src)
+
+
+def test_jax_dispatch_off_thread_and_waiver():
+    bad = """
+import jax
+CONCURRENCY_AUDIT = dict(name="m", locks={})
+def thunk(x):
+    return jax.device_put(x)
+def f(pool, x):
+    fut = pool.submit(thunk, x)
+    lam = pool.submit(lambda: jax.jit(lambda y: y)(x))
+    return fut.result(), lam.result()
+"""
+    lines = sorted(
+        f.line
+        for f in concurrency.audit_source(bad)
+        if f.rule == "jax-dispatch-off-thread"
+    )
+    assert lines == [5, 8]
+    waived = """
+import jax
+CONCURRENCY_AUDIT = dict(
+    name="m", locks={}, thread_entries=("thunk",),
+    jax_dispatch_ok={"thunk": "compile releases the GIL"})
+def thunk(x):
+    return jax.device_put(x)
+def f(pool, x):
+    fut = pool.submit(thunk, x)
+    return fut.result()
+"""
+    assert "jax-dispatch-off-thread" not in rules_of(waived)
+
+
+def test_jax_dispatch_waiver_requires_reason():
+    src = """
+import jax
+CONCURRENCY_AUDIT = dict(
+    name="m", locks={}, jax_dispatch_ok={"thunk": ""})
+def thunk(x):
+    return jax.device_put(x)
+def f(pool, x):
+    fut = pool.submit(thunk, x)
+    return fut.result()
+"""
+    findings = concurrency.audit_source(src)
+    assert any(
+        f.rule == "concurrency-contract" and "no reason" in f.message
+        for f in findings
+    )
+
+
+# ---------------------------------------------------------------------------
+# contract integrity / staleness
+# ---------------------------------------------------------------------------
+
+
+def test_machinery_without_contract_is_flagged():
+    src = """
+import threading
+_lock = threading.Lock()
+"""
+    findings = concurrency.audit_source(src)
+    assert [f.rule for f in findings] == ["concurrency-contract"]
+    assert "no CONCURRENCY_AUDIT" in findings[0].message
+
+
+def test_stale_contract_fixture():
+    """The acceptance fixture: a declared lock that no longer exists is
+    flagged, as are vanished guarded state, thread entries, and
+    jax_dispatch_ok names."""
+    src = """
+import threading
+CONCURRENCY_AUDIT = dict(
+    name="m",
+    locks={"_gone": ("_alsogone",), "_lock": ("_x",)},
+    thread_entries=("nosuch",),
+    jax_dispatch_ok={"missing": "was safe once"})
+_lock = threading.Lock()
+_x = None
+"""
+    msgs = [
+        f.message
+        for f in concurrency.audit_source(src)
+        if f.rule == "concurrency-contract"
+    ]
+    assert any("`_gone` is never created" in m for m in msgs)
+    assert any("`_alsogone`" in m and "stale" in m for m in msgs)
+    assert any("`nosuch`" in m for m in msgs)
+    assert any("`missing`" in m for m in msgs)
+
+
+def test_ambiguous_lock_terminal_names_are_flagged():
+    """Two locks sharing a terminal name would silently disable the
+    lock-order check and weaken the lockset (the auditor matches locks
+    by terminal name within a module) — flagged, not documented away.
+    data/pipeline.py's `_stats_lock` rename exists because of this."""
+    src = """
+import threading
+CONCURRENCY_AUDIT = dict(
+    name="m", locks={"A._lock": ("A._x",), "B._lock": ("B._y",)})
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = None
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._y = None
+"""
+    findings = concurrency.audit_source(src)
+    assert any(
+        f.rule == "concurrency-contract"
+        and "share the terminal name" in f.message
+        for f in findings
+    )
+    # Distinct terminals are clean.
+    clean = src.replace("B._lock", "B._b_lock").replace(
+        "class B:\n    def __init__(self):\n        self._lock",
+        "class B:\n    def __init__(self):\n        self._b_lock",
+    )
+    assert not any(
+        "share the terminal name" in f.message
+        for f in concurrency.audit_source(clean)
+    )
+
+
+def test_undeclared_lock_is_flagged():
+    src = """
+import threading
+CONCURRENCY_AUDIT = dict(name="m", locks={})
+_extra = threading.Lock()
+"""
+    findings = concurrency.audit_source(src)
+    assert [f.rule for f in findings] == ["concurrency-contract"]
+    assert "_extra" in findings[0].message
+
+
+def test_unparseable_contract_is_a_finding():
+    src = """
+import threading
+CONCURRENCY_AUDIT = dict(name="m", locks=make_locks())
+_lock = threading.Lock()
+"""
+    findings = concurrency.audit_source(src)
+    assert any(
+        f.rule == "concurrency-contract" and "does not parse" in f.message
+        for f in findings
+    )
+
+
+def test_suppression_with_reason_applies():
+    src = (
+        "import threading\n"
+        'CONCURRENCY_AUDIT = dict(name="m", locks={})\n'
+        "_extra = threading.Lock()"
+        "  # photon: ignore[concurrency-contract] -- migration in flight\n"
+    )
+    (finding,) = concurrency.audit_source(src)
+    assert finding.suppressed
+    assert finding.suppress_reason == "migration in flight"
+
+
+def test_syntax_error_is_a_finding():
+    (finding,) = concurrency.audit_source("def broken(:\n")
+    assert finding.rule == "syntax-error"
+
+
+# ---------------------------------------------------------------------------
+# the declared-contract inventory (the ISSUE's acceptance list)
+# ---------------------------------------------------------------------------
+
+
+def test_required_contracts_declared():
+    contracts = concurrency.collect_contracts([PACKAGE])
+    required = {
+        "ingest-pipeline": PACKAGE / "data" / "pipeline.py",
+        "obs-spans": PACKAGE / "obs" / "spans.py",
+        "obs-metrics": PACKAGE / "obs" / "metrics.py",
+        "obs-convergence": PACKAGE / "obs" / "convergence.py",
+        "event-bus": PACKAGE / "events.py",
+        "game-estimator-host": (
+            PACKAGE / "estimators" / "game_estimator.py"
+        ),
+        "compile-cache": PACKAGE / "utils" / "compile_cache.py",
+    }
+    missing = set(required) - set(contracts)
+    assert not missing, f"missing CONCURRENCY_AUDIT contracts: {missing}"
+    # Every jax_dispatch_ok waiver in the repo carries a reason.
+    for name, c in contracts.items():
+        for entry, reason in c.jax_dispatch_ok.items():
+            assert reason.strip(), (name, entry)
+
+
+def test_repo_lock_guarded_contracts_name_real_locks():
+    """Spot-check the declared lockset against the modules: the event
+    bus and compile cache (this PR's fixes) declare the locks that now
+    exist."""
+    contracts = concurrency.collect_contracts([PACKAGE])
+    assert "EventEmitter._lock" in contracts["event-bus"].locks
+    assert "_lock" in contracts["compile-cache"].locks
+    assert set(contracts["compile-cache"].locks["_lock"]) >= {
+        "_stats",
+        "_listener_installed",
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI + THE GATE
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--concurrency", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in concurrency.CONCURRENCY_RULES:
+        assert rule_id in out
+
+
+def test_cli_semantic_and_concurrency_are_exclusive(capsys):
+    assert cli_main(["--semantic", "--concurrency"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_select_is_a_usage_error(capsys):
+    assert cli_main(["--concurrency", "--select", "dropped-future"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_json_and_exit_code(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import threading\n_lock = threading.Lock()\n"
+    )
+    assert cli_main(["--concurrency", str(bad), "--format", "json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["summary"]["unsuppressed"] == 1
+    assert data["findings"][0]["rule"] == "concurrency-contract"
+
+
+def test_cli_missing_path_is_usage_error(tmp_path, capsys):
+    assert cli_main(["--concurrency", str(tmp_path / "nope")]) == 2
+    capsys.readouterr()
+
+
+def test_concurrency_gate_zero_unsuppressed_findings(capsys):
+    """THE GATE: `python -m photon_tpu.analysis --concurrency` exits 0
+    on the repo, and any suppression it carries has a written reason."""
+    rc = cli_main(["--concurrency", str(PACKAGE), "--show-suppressed"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"concurrency gate failed:\n{out}"
+    for f in concurrency.audit_paths([PACKAGE]):
+        assert f.suppressed, f.format()
+        assert f.suppress_reason and f.suppress_reason.strip(), (
+            f"suppression without a reason: {f.format()}"
+        )

@@ -40,6 +40,74 @@ def test_emitter_registry():
     assert got == [e]
 
 
+def test_listener_mutation_during_emit_does_not_skip():
+    """The fan-out iterates a snapshot taken under the emitter's lock:
+    a listener removing itself mid-emit must not skip the listener that
+    followed it (the classic mutate-during-iteration bug the pre-fix
+    in-place loop had)."""
+    emitter = EventEmitter()
+    got = []
+
+    def self_removing(e):
+        emitter.remove_listener(self_removing)
+        got.append("self")
+
+    emitter.add_listener(self_removing)
+    emitter.add_listener(lambda e: got.append("tail"))
+    emitter.send_event(PhotonEvent())
+    assert got == ["self", "tail"]
+    got.clear()
+    emitter.send_event(PhotonEvent())
+    assert got == ["tail"]
+
+
+def test_listener_added_during_emit_sees_next_event_only():
+    emitter = EventEmitter()
+    got = []
+
+    def adder(e):
+        got.append("adder")
+        emitter.add_listener(lambda ev: got.append("late"))
+        emitter.remove_listener(adder)
+
+    emitter.add_listener(adder)
+    emitter.send_event(PhotonEvent())
+    assert got == ["adder"]  # the late listener missed the live emit
+    emitter.send_event(PhotonEvent())
+    assert got == ["adder", "late"]
+
+
+def test_concurrent_register_during_fanout_hammer():
+    """Registry mutation from another thread while the training thread
+    fans out: the CONCURRENCY_AUDIT contract's runtime counterpart —
+    no exception, no deadlock, and the stable listener sees every
+    event exactly once."""
+    import threading
+
+    emitter = EventEmitter()
+    count = [0]
+    emitter.add_listener(lambda e: count.__setitem__(0, count[0] + 1))
+    stop = threading.Event()
+
+    def churn():
+        flip = lambda e: None  # noqa: E731 — identity matters, not body
+        while not stop.is_set():
+            emitter.add_listener(flip)
+            emitter.remove_listener(flip)
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        n = 500
+        for _ in range(n):
+            emitter.send_event(PhotonEvent())
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not t.is_alive()
+    assert count[0] == n
+
+
 def test_listener_exception_propagates():
     emitter = EventEmitter([lambda e: (_ for _ in ()).throw(RuntimeError("x"))])
     with pytest.raises(RuntimeError):
