@@ -84,6 +84,7 @@ DECLARING_MODULES = (
     "photon_tpu.obs",
     "photon_tpu.ops.newton_kernel",
     "photon_tpu.parallel.mesh",
+    "photon_tpu.serve",
 )
 
 _CALLBACK_PRIMITIVES = frozenset(
@@ -1007,6 +1008,132 @@ def build_telemetry() -> ContractTrace:
     )
 
 
+def build_serving() -> ContractTrace:
+    """The serving score ladder's zero-recompile contract.
+
+    A small GLMix model (one dense fixed effect + one random effect with
+    a non-trivial projector) is loaded into serving tables and its
+    ladder program traced at every rung — those are the base programs
+    (census bound = rung count). Two variant families then prove the
+    steady state is CLOSED:
+
+    - ``request_batch``: every request count from 1 to the top rung,
+      padded through the PRODUCTION pad rule (``ShapeLadder.rung_for``),
+      must trace to the signature of its rung's base program — a pad
+      rule that leaked an unpadded (or wrongly padded) shape would mint
+      a new program here and fail both the census and the stability
+      check.
+    - ``model_reload``: the tables refreshed in place with different
+      coefficient VALUES (same shapes) must trace every rung to a
+      byte-identical signature — coefficients are traced operands, so a
+      model reload can never trigger a recompile in a serving process.
+
+    The fit programs' ``hot_loop`` host-boundary walk applies too: no
+    callback primitive may live in the request hot path.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+    from photon_tpu.types import TaskType
+
+    d, e, s, du = 5, 7, 3, 6
+    rng = np.random.default_rng(20260803)
+
+    def model_for(scale: float) -> GameModel:
+        # Fixed-seed projector: the reload variant below must be a
+        # VALUES-ONLY refresh (reload's in-place condition).
+        prng = np.random.default_rng(1234)
+        proj = np.sort(
+            np.stack([
+                prng.permutation(du)[:s] for _ in range(e)
+            ]), axis=1,
+        ).astype(np.int64)
+        return GameModel({
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(means=jnp.asarray(
+                        scale * rng.normal(size=d).astype(np.float32)
+                    )),
+                    TaskType.LOGISTIC_REGRESSION,
+                ),
+                "features",
+            ),
+            "per-user": RandomEffectModel(
+                coefficients=jnp.asarray(
+                    scale * rng.normal(size=(e, s)).astype(np.float32)
+                ),
+                random_effect_type="userId",
+                feature_shard_id="userShard",
+                task=TaskType.LOGISTIC_REGRESSION,
+                proj_all=proj,
+                entity_keys=tuple(str(i) for i in range(e)),
+            ),
+        })
+
+    ladder = ShapeLadder((1, 8, 64))
+    tables = CoefficientTables.from_game_model(model_for(1.0))
+    programs = ScorePrograms(tables, ladder=ladder, compile_now=False)
+
+    def rung_program(progs: ScorePrograms, batch: int) -> TracedProgram:
+        traced = progs.trace(batch)
+        return TracedProgram(
+            name=f"score_b{batch}",
+            text=str(traced.jaxpr),
+            jaxpr=traced.jaxpr,
+            lowered=traced.lower(),
+        )
+
+    base = {
+        f"score_b{r}": rung_program(programs, r) for r in ladder.rungs
+    }
+
+    variants: dict[str, list[dict[str, str]]] = {
+        "request_batch": [],
+        "model_reload": [],
+    }
+    # One fresh trace per DISTINCT shape the pad rule produces (a
+    # broken rung_for surfaces as a new shape here — traced at n, its
+    # signature both breaks the census bound and misses the base
+    # programs); re-tracing identical rungs per request count would add
+    # gate wall-clock for zero signal.
+    rung_sigs: dict[int, str] = {}
+    for n in range(1, ladder.max_batch + 1):
+        rung = ladder.rung_for(n)
+        if rung not in rung_sigs:
+            rung_sigs[rung] = TracedProgram(
+                name="v", text=str(programs.trace(rung).jaxpr)
+            ).signature
+        variants["request_batch"].append(
+            {f"score_b{rung}": rung_sigs[rung]}
+        )
+    tables.reload(model_for(2.5))
+    variants["model_reload"].append({
+        name: TracedProgram(
+            name="v", text=str(programs.trace(r).jaxpr)
+        ).signature
+        for r, name in zip(ladder.rungs, base)
+    })
+    return ContractTrace(
+        programs=base,
+        variants=variants,
+        notes=[
+            f"ladder {ladder.rungs}: every request count 1.."
+            f"{ladder.max_batch} pads into the {len(ladder.rungs)} "
+            "compiled rungs; an in-place model reload re-traces to "
+            "byte-identical programs (tables are traced operands)",
+        ],
+    )
+
+
 def build_evaluators() -> ContractTrace:
     """Evaluation + scoring entry points: shape-specialized (a row-count
     change recompiles, by design), value-stable, no host callbacks."""
@@ -1054,6 +1181,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_mesh_sharding": build_mesh_sharding,
     "build_ingest_pipeline": build_ingest_pipeline,
     "build_telemetry": build_telemetry,
+    "build_serving": build_serving,
     "build_evaluators": build_evaluators,
 }
 

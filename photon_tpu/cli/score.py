@@ -92,15 +92,10 @@ def _run(args) -> int:
             kv.split("=", 1) for kv in args.input_columns
         )
 
+    from photon_tpu.io.model_io import model_feature_shard_ids
+
     records = avro.read_container_dir(args.input)
-    needed_shards = set()
-    import os.path as osp
-    for kind in ("fixed-effect", "random-effect"):
-        d = osp.join(args.model_dir, kind)
-        if osp.isdir(d):
-            for name in os.listdir(d):
-                with open(osp.join(d, name, "id-info")) as f:
-                    needed_shards.add(f.read().strip().splitlines()[-1])
+    needed_shards = model_feature_shard_ids(args.model_dir)
 
     if args.feature_shards:
         # Multi-bag layout: per-shard tables + per-shard index maps — the
@@ -149,9 +144,9 @@ def _run(args) -> int:
     )
     from photon_tpu.parallel.mesh import resolve_mesh
 
-    transformer = GameTransformer(model, mesh=resolve_mesh(args.mesh))
-    scores, evaluation = transformer.transform(
-        data, evaluators=args.evaluators
+    scores, evaluation = score_game_dataset(
+        model, data, mesh=resolve_mesh(args.mesh),
+        evaluators=args.evaluators,
     )
 
     from photon_tpu.cli.common import fetch_global, is_coordinator
@@ -182,6 +177,56 @@ def _run(args) -> int:
             json.dump(evaluation.evaluations, f, indent=2)
     print(json.dumps(out))
     return 0
+
+
+def score_game_dataset(model, data, *, mesh=None, evaluators=None):
+    """Batch scoring routed through the SERVING implementation.
+
+    Single-device batch scoring and online serving share one scoring
+    path: the HBM-resident coefficient tables + the AOT score ladder
+    (``serve/tables.py`` / ``serve/programs.py``), chunked over the
+    dataset — so a score served online and a score computed offline for
+    the same row are the same program family by construction (pinned by
+    tests/test_serve.py parity tests). The mesh path (row-sharded score
+    tables) and DualEll-layout shards keep the ``GameTransformer``
+    route: their layouts have no fixed per-request shape.
+    """
+    serve_specs = None
+    if mesh is None:
+        from photon_tpu.serve.programs import specs_from_dataset
+
+        try:
+            serve_specs = specs_from_dataset(data)
+        except TypeError:
+            serve_specs = None  # DualEll shard: no fixed row layout
+    if serve_specs is None:
+        from photon_tpu.transformers import GameTransformer
+
+        return GameTransformer(model, mesh=mesh).transform(
+            data, evaluators=evaluators
+        )
+    import jax.numpy as jnp
+
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+    from photon_tpu.transformers import evaluate_scores
+
+    tables = CoefficientTables.from_game_model(model)
+    # compile_now=False: score_dataset compiles exactly the rungs its
+    # chunk plan dispatches, so a small file never pays the top rung's
+    # compile.
+    programs = ScorePrograms(
+        tables, ladder=ShapeLadder(BATCH_RUNGS), specs=serve_specs,
+        compile_now=False,
+    )
+    scores = jnp.asarray(programs.score_dataset(data))
+    return scores, evaluate_scores(data, scores, evaluators)
+
+
+# Batch-mode score ladder: the large rung amortizes dispatch overhead
+# over file-sized inputs; the small tail rung bounds padding waste. (The
+# online default 1/8/64/512 ladder optimizes latency instead.)
+BATCH_RUNGS = (1024, 8192)
 
 
 def _alias_shards(data, shard_names):

@@ -255,6 +255,24 @@ def save_game_model(
             raise TypeError(f"unknown sub-model type for {name!r}")
 
 
+def model_feature_shard_ids(model_dir: str) -> set[str]:
+    """The feature shard ids a saved model directory references.
+
+    Reads each sub-model's ``id-info`` (shard id is the LAST line —
+    fixed effects write one line, random effects two). Shared by the
+    scoring/serving drivers to decide which index maps a load needs.
+    """
+    shards: set[str] = set()
+    for kind in (FIXED_EFFECT, RANDOM_EFFECT):
+        base = os.path.join(model_dir, kind)
+        if not os.path.isdir(base):
+            continue
+        for name in os.listdir(base):
+            with open(os.path.join(base, name, ID_INFO)) as f:
+                shards.add(f.read().strip().splitlines()[-1])
+    return shards
+
+
 def load_game_model(
     input_dir: str,
     index_maps: dict[str, IndexMap],

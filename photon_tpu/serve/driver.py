@@ -1,0 +1,227 @@
+"""Synchronous serving driver: feed requests, measure the tail.
+
+No network dependency — the driver is the load generator AND the
+client: it pushes requests (synthetic, or rows of a ``GameDataset``)
+through a ``MicroBatchQueue`` from the calling thread, timestamps each
+request's completion via a done-callback on the worker thread, and
+reports the latency/throughput summary the bench and the serve CLI
+emit: p50/p99 latency, QPS, batch-fill fraction, cold-entity rate.
+
+The driver owns no threads and no locks: per-request latencies land in
+a plain list appended only from the queue's single worker thread (the
+done-callbacks), read only after every future resolved.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from photon_tpu.serve.programs import ScorePrograms
+from photon_tpu.serve.queue import MicroBatchQueue
+from photon_tpu.serve.tables import CoefficientTables
+
+
+def synthetic_requests(
+    tables: CoefficientTables,
+    programs: ScorePrograms,
+    n: int,
+    *,
+    cold_fraction: float = 0.05,
+    seed: int = 0,
+) -> list[tuple[dict, dict]]:
+    """``n`` synthetic ``(features, entity_ids)`` requests for a model.
+
+    Dense feature vectors drawn N(0,1) per shard spec; entity ids drawn
+    from each random table's real vocabulary, with ``cold_fraction`` of
+    lookups replaced by keys the model never trained — the cold-entity
+    fallback path is part of the measured workload, as it is in
+    production traffic.
+    """
+    rng = np.random.default_rng(seed)
+    reqs: list[tuple[dict, dict]] = []
+    vocab = {
+        rt: next(
+            t.entity_keys
+            for t in tables.random.values()
+            if t.random_effect_type == rt
+        )
+        for rt in programs.retype_order
+    }
+    for i in range(n):
+        feats = {}
+        for s in programs.shard_order:
+            spec = programs.specs[s]
+            if spec.kind == "dense":
+                feats[s] = rng.normal(size=spec.d).astype(programs.dtype)
+            else:
+                feats[s] = (
+                    rng.integers(0, spec.d, size=spec.k).astype(np.int32),
+                    rng.normal(size=spec.k).astype(programs.dtype),
+                )
+        ids = {}
+        for rt, keys in vocab.items():
+            if keys and rng.uniform() >= cold_fraction:
+                ids[rt] = keys[int(rng.integers(0, len(keys)))]
+            else:
+                ids[rt] = f"__cold_{i}"
+        reqs.append((feats, ids))
+    return reqs
+
+
+def dataset_requests(
+    data, programs: ScorePrograms
+) -> list[tuple[dict, dict]]:
+    """One request per dataset row (the file-driven serve CLI path)."""
+    from photon_tpu.data.dataset import DenseFeatures
+
+    n = data.num_samples
+    host: dict[str, object] = {}
+    for s in programs.shard_order:
+        feats = data.feature_shards[s]
+        if isinstance(feats, DenseFeatures):
+            host[s] = ("dense", np.asarray(feats.x))
+        else:
+            host[s] = (
+                "sparse",
+                np.asarray(feats.indices),
+                np.asarray(feats.values),
+            )
+    tags = {
+        rt: data.id_tags[rt] for rt in programs.retype_order
+    }
+    keys = {
+        rt: [tag.inverse[c] for c in tag.host_codes()]
+        for rt, tag in tags.items()
+    }
+    reqs: list[tuple[dict, dict]] = []
+    for i in range(n):
+        feats = {}
+        for s, leaf in host.items():
+            if leaf[0] == "dense":
+                feats[s] = leaf[1][i]
+            else:
+                feats[s] = (leaf[1][i], leaf[2][i])
+        reqs.append((feats, {rt: k[i] for rt, k in keys.items()}))
+    return reqs
+
+
+def drive(
+    queue: MicroBatchQueue,
+    requests: list[tuple[dict, dict]],
+    *,
+    warmup: int | None = None,
+    rate: float | None = None,
+) -> dict:
+    """Push ``requests`` through ``queue``; return the serving summary.
+
+    A warmup prefix (default: one max-batch worth per ladder rung)
+    exercises every compiled rung before measurement starts, so the
+    p50/p99 numbers describe the steady state — and so "zero programs
+    added after warmup" is checkable by the caller (compile-cache event
+    deltas across the measured window).
+
+    ``rate=None`` floods (closed-loop saturation: QPS is the ceiling and
+    latency includes queueing delay behind ``max_queue``); a requests/s
+    ``rate`` paces submission on a fixed schedule, making p50/p99 a
+    service-latency measurement at that offered load.
+    """
+    ladder = queue.programs.ladder
+    if warmup is None:
+        warmup = min(len(requests) // 4, sum(ladder.rungs))
+    warm, measured = requests[:warmup], requests[warmup:]
+    if not measured:
+        raise ValueError(
+            f"{len(requests)} requests leave nothing to measure after "
+            f"a {warmup}-request warmup"
+        )
+
+    warm_futures = [queue.submit(feats, ids) for feats, ids in warm]
+    for fut in warm_futures:
+        # Warmup completes (and surfaces its failures) BEFORE the
+        # measured window opens — warm dispatches must not overlap it.
+        fut.result()
+    # Queue counters snapshot: the fill/cold numbers below are DELTAS
+    # over the measured window, so they describe the same workload as
+    # the latency percentiles (warmup floods in one burst and would
+    # overstate steady-state batch fill).
+    warm_stats = queue.stats()
+
+    # (submit time, completion time, future) per request; appended only
+    # from the queue's worker thread (the done-callback), read only
+    # after every future resolved.
+    completions: list[tuple[float, float, object]] = []
+
+    def on_done(t0: float):
+        def cb(fut):
+            completions.append((t0, time.perf_counter(), fut))
+
+        return cb
+
+    futures = []
+    t_start = time.perf_counter()
+    for i, (feats, ids) in enumerate(measured):
+        if rate:
+            due = t_start + i / rate
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        t0 = time.perf_counter()
+        fut = queue.submit(feats, ids)
+        fut.add_done_callback(on_done(t0))
+        futures.append(fut)
+    errors = 0
+    first_error: BaseException | None = None
+    for fut in futures:
+        exc = fut.exception()
+        if exc is not None:
+            errors += 1
+            first_error = first_error or exc
+    if errors == len(futures) and first_error is not None:
+        raise first_error  # nothing scored: surface the real failure
+    # Latency/QPS describe SERVED requests only: a failed request's
+    # time-to-error is not a service latency, and counting failures as
+    # throughput would let a poisoned batch IMPROVE the reported tail.
+    ok = [
+        (t0, td) for t0, td, f in completions if f.exception() is None
+    ]
+    lat = [td - t0 for t0, td in ok]
+    done_at = [td for _, td in ok]
+    t_end = max(done_at) if done_at else time.perf_counter()
+    lat_arr = np.asarray(sorted(lat))
+    wall = max(t_end - t_start, 1e-9)
+    out = {
+        "requests": len(measured),
+        "warmup_requests": len(warm),
+        "errors": errors,
+        "p50_ms": round(float(np.percentile(lat_arr, 50)) * 1e3, 3),
+        "p90_ms": round(float(np.percentile(lat_arr, 90)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lat_arr, 99)) * 1e3, 3),
+        "max_ms": round(float(lat_arr[-1]) * 1e3, 3),
+        "qps": round(len(lat) / wall, 1),
+        "wall_seconds": round(wall, 4),
+        "offered_rate": rate,
+    }
+    qstats = queue.stats()
+    batches = qstats["batches"] - warm_stats["batches"]
+    batched = (
+        qstats["batched_requests"] - warm_stats["batched_requests"]
+    )
+    cold = qstats["cold_lookups"] - warm_stats["cold_lookups"]
+    lookups = qstats["entity_lookups"] - warm_stats["entity_lookups"]
+    out["batch_fill_fraction"] = (
+        round(batched / (batches * queue.max_batch), 4)
+        if batches else None
+    )
+    out["mean_batch_size"] = (
+        round(batched / batches, 2) if batches else None
+    )
+    out["cold_entity_rate"] = (
+        round(cold / lookups, 4) if lookups else None
+    )
+    out["batches"] = batches
+    out["dispatch_errors"] = (
+        qstats["dispatch_errors"] - warm_stats["dispatch_errors"]
+    )
+    return out

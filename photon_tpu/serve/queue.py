@@ -1,0 +1,343 @@
+"""The serving micro-batch queue: bounded, lingering, draining.
+
+One worker thread owns all device dispatch; producers (request handler
+threads, the synchronous driver) hand ``(features, entity_ids)`` pairs
+to ``submit`` and get a ``Future`` back. The flush policy is the usual
+latency/throughput dial: a batch dispatches when it reaches
+``max_batch`` requests (clamped to the score ladder's top rung) OR when
+the OLDEST queued request has lingered ``max_linger_s`` — small linger
+= low p99, large linger = fuller batches = higher QPS. The queue is
+bounded (``max_queue``): producers block for space, so an overloaded
+server applies backpressure instead of growing an unbounded heap.
+
+Shutdown drains: ``close()`` wakes the worker, which keeps flushing
+until the queue is empty, then exits; every in-flight future resolves.
+A submit after close fails fast. Exceptions from a batch dispatch fan
+out to THAT batch's futures (each waiter sees the error; the worker
+keeps serving subsequent batches).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`). The threading model is single-consumer: ONE worker
+# thread pops, pads, dispatches, and scatters; any number of producer
+# threads push. `_cond` (a Condition, which is also the mutex) guards
+# the pending deque, the closed flag, and the stats dict; the worker
+# snapshots a batch UNDER the lock and dispatches OUTSIDE it, so
+# producers never queue behind an XLA execution. Futures are created
+# here (not executor-submitted) and every one is resolved — by the
+# batch's results, by the batch's exception, or by close()'s
+# drain — so no waiter can hang on a dropped future.
+CONCURRENCY_AUDIT = dict(
+    name="serve-queue",
+    locks={
+        "MicroBatchQueue._cond": (
+            "MicroBatchQueue._pending",
+            "MicroBatchQueue._closed",
+            "MicroBatchQueue._stats",
+        ),
+        "_Future._lock": (
+            "_Future._callbacks",
+            "_Future._value",
+            "_Future._exc",
+            "_Future._resolved",
+        ),
+    },
+    thread_entries=(
+        "MicroBatchQueue._worker",
+        "MicroBatchQueue._dispatch",
+    ),
+    jax_dispatch_ok={
+        "_worker": "the worker loop itself only pops/waits; all device "
+        "work is in _dispatch (declared below)",
+        "_dispatch": "dispatches PRE-COMPILED AOT executables only "
+        "(ScorePrograms.score_padded) — no tracing, no compilation can "
+        "occur on this thread (the ladder is compiled at construction "
+        "on the caller's thread and score_padded raises on an "
+        "un-compiled rung); the single worker thread serializes every "
+        "dispatch, and the np.asarray fetch is the request path's one "
+        "intended host sync",
+    },
+)
+
+
+class QueueClosed(RuntimeError):
+    """submit() after close()."""
+
+
+class _Request:
+    __slots__ = ("features", "entity_ids", "future", "enqueued_at")
+
+    def __init__(self, features: dict, entity_ids: dict):
+        self.features = features
+        self.entity_ids = entity_ids
+        self.future = _Future()
+        self.enqueued_at = time.perf_counter()
+
+
+class _Future:
+    """Minimal single-shot future (no executor): set exactly once by
+    the worker, waited on by the producer. Done callbacks run on the
+    worker thread at resolution — the driver uses them to timestamp
+    completion without a per-request host thread. ``_lock`` closes the
+    register-vs-resolve race: without it a callback added while the
+    worker resolves could be dropped silently."""
+
+    __slots__ = (
+        "_lock", "_event", "_value", "_exc", "_callbacks", "_resolved"
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._value = None
+        self._exc: BaseException | None = None
+        self._callbacks: list = []
+        self._resolved = False
+
+    def _resolve(self, value, exc: BaseException | None) -> None:
+        with self._lock:
+            if self._resolved:
+                raise RuntimeError("future resolved twice")
+            self._resolved = True
+            self._value = value
+            self._exc = exc
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:  # outside the lock: callbacks are user code
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — a raising callback must
+                # not kill the worker thread (stranding every queued
+                # future); same logged-and-continue contract as
+                # concurrent.futures.
+                logger.exception("serve future done-callback raised")
+        # The event flips only AFTER the registered callbacks ran, so a
+        # waiter that observes done() may rely on its callback's side
+        # effects (the driver's latency append). Callbacks therefore
+        # must never wait on this future themselves.
+        self._event.set()
+
+    def set_result(self, value) -> None:
+        self._resolve(value, None)
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._resolve(None, exc)
+
+    def add_done_callback(self, cb) -> None:
+        with self._lock:
+            if not self._resolved:
+                self._callbacks.append(cb)
+                return
+        cb(self)
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("score request still queued")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def exception(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("score request still queued")
+        return self._exc
+
+
+class MicroBatchQueue:
+    """Bounded micro-batching front of a ``ScorePrograms`` ladder."""
+
+    def __init__(
+        self,
+        programs,
+        *,
+        max_batch: int | None = None,
+        max_linger_s: float = 0.002,
+        max_queue: int = 4096,
+    ):
+        self.programs = programs
+        top = programs.ladder.max_batch
+        self.max_batch = min(
+            top if max_batch is None else int(max_batch), top
+        )
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_linger_s = float(max_linger_s)
+        self.max_queue = max(int(max_queue), self.max_batch)
+        self._cond = threading.Condition()
+        self._pending: collections.deque[_Request] = collections.deque()
+        self._closed = False
+        self._stats = {
+            "requests": 0,
+            "batches": 0,
+            "batched_requests": 0,
+            "cold_lookups": 0,
+            "entity_lookups": 0,
+            "rejected": 0,
+            "dispatch_errors": 0,
+        }
+        self._thread = threading.Thread(
+            target=self._worker, name="photon-serve-worker"
+        )
+        self._thread.start()
+
+    # -- producer side ----------------------------------------------------
+
+    def submit(self, features: dict, entity_ids: dict | None = None):
+        """Queue one request; returns its Future.
+
+        ``features`` maps feature shard id -> the spec's request leaf
+        (dense: [d] vector; sparse: ([k] indices, [k] values));
+        ``entity_ids`` maps random-effect type -> entity key. Blocks
+        while the queue is at ``max_queue`` (backpressure).
+        """
+        req = _Request(features, dict(entity_ids or {}))
+        with self._cond:
+            while (
+                len(self._pending) >= self.max_queue and not self._closed
+            ):
+                self._cond.wait()
+            if self._closed:
+                self._stats["rejected"] += 1
+                raise QueueClosed("serve queue is closed")
+            self._pending.append(req)
+            self._stats["requests"] += 1
+            self._cond.notify_all()
+        return req.future
+
+    def close(self) -> None:
+        """Stop accepting requests, drain everything queued, join the
+        worker. Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join()
+
+    def __enter__(self) -> "MicroBatchQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> dict:
+        """Snapshot of the queue counters (+ derived fill/cold rates)."""
+        with self._cond:
+            snap = dict(self._stats)
+            snap["queued_now"] = len(self._pending)
+        if snap["batches"]:
+            snap["batch_fill_fraction"] = round(
+                snap["batched_requests"]
+                / (snap["batches"] * self.max_batch),
+                4,
+            )
+            snap["mean_batch_size"] = round(
+                snap["batched_requests"] / snap["batches"], 2
+            )
+        else:
+            snap["batch_fill_fraction"] = None
+            snap["mean_batch_size"] = None
+        snap["cold_entity_rate"] = (
+            round(snap["cold_lookups"] / snap["entity_lookups"], 4)
+            if snap["entity_lookups"]
+            else None
+        )
+        return snap
+
+    # -- worker side ------------------------------------------------------
+
+    def _take_batch(self) -> list[_Request] | None:
+        """Block for the next batch per the flush policy; None = exit.
+
+        Runs on the worker thread. Returns once ``max_batch`` requests
+        are pending, the oldest pending request has lingered
+        ``max_linger_s``, or the queue closed (flush what remains;
+        return None only when closed AND empty).
+        """
+        with self._cond:
+            while True:
+                if self._pending:
+                    deadline = (
+                        self._pending[0].enqueued_at + self.max_linger_s
+                    )
+                    while (
+                        len(self._pending) < self.max_batch
+                        and not self._closed
+                    ):
+                        remaining = deadline - time.perf_counter()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(timeout=remaining)
+                    batch = [
+                        self._pending.popleft()
+                        for _ in range(
+                            min(len(self._pending), self.max_batch)
+                        )
+                    ]
+                    self._stats["batches"] += 1
+                    self._stats["batched_requests"] += len(batch)
+                    self._cond.notify_all()  # space freed: wake producers
+                    return batch
+                if self._closed:
+                    return None
+                self._cond.wait()
+
+    def _worker(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list[_Request]) -> None:
+        """Pad, score, scatter — outside the lock (producers keep
+        queuing while XLA runs). Runs on the worker thread only."""
+        from photon_tpu import obs
+
+        t0 = time.perf_counter()
+        try:
+            feats, codes, _rung = self.programs.pack_requests(
+                [(r.features, r.entity_ids) for r in batch]
+            )
+            cold = sum(
+                int(np.sum(vec[: len(batch)] < 0))
+                for vec in codes.values()
+            )
+            lookups = len(codes) * len(batch)
+            with obs.span("serve/batch"):
+                scores = self.programs.score_padded(
+                    feats, codes, len(batch)
+                )
+        except Exception as exc:  # noqa: BLE001 — fan out to the waiters
+            with self._cond:
+                self._stats["dispatch_errors"] += 1
+            for r in batch:
+                r.future.set_exception(exc)
+            return
+        with self._cond:
+            self._stats["cold_lookups"] += cold
+            self._stats["entity_lookups"] += lookups
+        if obs.enabled():
+            obs.REGISTRY.counter("serve_requests_total").inc(len(batch))
+            obs.REGISTRY.counter("serve_batches_total").inc()
+            if lookups:
+                obs.REGISTRY.counter("serve_cold_lookups_total").inc(cold)
+            obs.REGISTRY.histogram("serve_batch_fill").observe(
+                len(batch) / self.max_batch
+            )
+            obs.REGISTRY.histogram("serve_batch_seconds").observe(
+                time.perf_counter() - t0
+            )
+        for r, s in zip(batch, scores):
+            r.future.set_result(float(s))

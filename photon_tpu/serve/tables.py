@@ -1,0 +1,304 @@
+"""HBM-resident coefficient tables for online scoring.
+
+A trained ``GameModel`` holds sub-models in training-time layout; serving
+needs them as *lookup tables*: one dense weight vector per fixed-effect
+coordinate and, per random-effect coordinate, the padded ``[E, S]``
+coefficient matrix next to its ``[E, S]`` projector (original feature id
+per subspace slot) on device plus a HOST map entity key -> row index.
+Scoring is then pure index arithmetic against resident arrays — the same
+fused kernels batch scoring uses (``models/game._score_raw_dense`` /
+``_score_raw_sparse``), so online and batch scores agree by construction.
+
+Cold entities (keys absent from the map) get code -1, which the kernels
+mask to a zero random-effect contribution: the request still scores
+through the fixed effect — photon-ml's left-join-with-no-match semantics.
+
+``reload`` swaps a refreshed model into the live tables without a
+recompile (coefficient arrays are traced operands, audited by the
+tier-2 ``serving`` contract): the default is a reference swap that is
+safe against live dispatch (in-flight batches pin the old generation),
+``donate=True`` writes the new values into the OLD buffers' HBM via a
+donating jitted copy for memory-constrained QUIESCED reloads; a
+structure change (new entities, new coordinates) rebuilds the tables
+and the caller must rebuild its programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.types import TaskType, make_feature_key
+
+_swap_cache: dict[tuple, object] = {}
+
+
+def _device_swap(old, new_host: np.ndarray):
+    """Replace ``old``'s values with ``new_host``, donating ``old``.
+
+    The donated input lets XLA alias the output into the old buffer's
+    HBM — the reload writes the fresh coefficients into the memory the
+    serving programs already read, instead of holding both generations
+    resident while the transfer drains. Donation marks ``old`` deleted,
+    so this path requires the serving queue QUIESCED (see
+    ``CoefficientTables.reload(donate=True)``). CPU backends skip
+    donation (same guard as data/pipeline._concat_chunks: the backend
+    would warn on every call)."""
+    import jax
+
+    key = (tuple(old.shape), str(old.dtype))
+    fn = _swap_cache.get(key)
+    if fn is None:
+        donate = (0,) if jax.default_backend() not in ("cpu",) else ()
+        fn = jax.jit(lambda prev, new: new, donate_argnums=donate)
+        _swap_cache[key] = fn
+    return fn(old, new_host)
+
+
+@dataclasses.dataclass
+class FixedTable:
+    """One fixed-effect coordinate: the dense [d] weight vector."""
+
+    name: str
+    feature_shard_id: str
+    task: TaskType
+    weights: object  # jax.Array [d]
+
+    @property
+    def num_features(self) -> int:
+        return int(self.weights.shape[0])
+
+
+@dataclasses.dataclass
+class RandomTable:
+    """One random-effect coordinate: padded per-entity coefficients."""
+
+    name: str
+    random_effect_type: str
+    feature_shard_id: str
+    task: TaskType
+    weights: object  # jax.Array [E, S]
+    proj: object  # jax.Array [E, S] int32, -1 pad
+    entity_keys: tuple  # row i <-> entity_keys[i]
+    entity_rows: dict  # str key -> row index (host map)
+
+    @property
+    def num_entities(self) -> int:
+        return int(self.weights.shape[0])
+
+    @property
+    def num_features(self) -> int:
+        """Original-space feature dim the projector can address. The
+        model alone does not record the shard width, so this is the
+        tightest bound the projector implies (features beyond it can
+        never contribute — their slots do not exist)."""
+        p = np.asarray(self.proj)
+        return int(p.max(initial=-1)) + 1 if p.size else 1
+
+    def code_for(self, key) -> int:
+        """Row index for an entity key; -1 = cold (fixed-effect-only)."""
+        row = self.entity_rows.get(str(key))
+        return -1 if row is None else row
+
+
+@dataclasses.dataclass
+class CoefficientTables:
+    """Device-resident serving state for one GameModel."""
+
+    fixed: dict[str, FixedTable]
+    random: dict[str, RandomTable]
+    task: TaskType
+
+    @property
+    def coordinate_order(self) -> tuple[str, ...]:
+        """Stable coordinate order (model iteration order) shared with
+        the score-program operand layout."""
+        return tuple(self.fixed) + tuple(self.random)
+
+    @property
+    def retype_order(self) -> tuple[str, ...]:
+        """Distinct random-effect types in first-appearance order — one
+        REQUEST entity id per type. (Row codes are per COORDINATE, not
+        per type: coordinates sharing a type may hold distinct entity
+        vocabularies, so each table resolves its own code.)"""
+        seen: list[str] = []
+        for t in self.random.values():
+            if t.random_effect_type not in seen:
+                seen.append(t.random_effect_type)
+        return tuple(seen)
+
+    def codes_for(self, entity_ids: dict) -> dict[str, int]:
+        """Per-COORDINATE row codes for one request (-1 = cold); the
+        request's entity id is keyed by the coordinate's re_type."""
+        return {
+            name: t.code_for(entity_ids.get(t.random_effect_type, ""))
+            for name, t in self.random.items()
+        }
+
+    @staticmethod
+    def from_game_model(model: GameModel) -> "CoefficientTables":
+        import jax
+        import jax.numpy as jnp
+
+        fixed: dict[str, FixedTable] = {}
+        random: dict[str, RandomTable] = {}
+        for name, sub in model.items():
+            if isinstance(sub, FixedEffectModel):
+                fixed[name] = FixedTable(
+                    name=name,
+                    feature_shard_id=sub.feature_shard_id,
+                    task=sub.task,
+                    weights=jax.device_put(
+                        jnp.asarray(sub.model.coefficients.means)
+                    ),
+                )
+            elif isinstance(sub, RandomEffectModel):
+                keys = tuple(str(k) for k in sub.entity_keys)
+                random[name] = RandomTable(
+                    name=name,
+                    random_effect_type=sub.random_effect_type,
+                    feature_shard_id=sub.feature_shard_id,
+                    task=sub.task,
+                    weights=jax.device_put(jnp.asarray(sub.coefficients)),
+                    proj=jax.device_put(
+                        jnp.asarray(
+                            np.asarray(sub.proj_all).astype(np.int32)
+                        )
+                    ),
+                    entity_keys=keys,
+                    entity_rows={k: i for i, k in enumerate(keys)},
+                )
+            else:
+                raise TypeError(f"unknown sub-model type for {name!r}")
+        return CoefficientTables(fixed=fixed, random=random, task=model.task)
+
+    def structure_key(self) -> tuple:
+        """Everything a score program specializes on: coordinate names,
+        kinds, shard wiring, and array shapes/dtypes. Two models with
+        equal keys serve through the SAME compiled ladder."""
+        fe = tuple(
+            (n, t.feature_shard_id, tuple(t.weights.shape),
+             str(t.weights.dtype))
+            for n, t in self.fixed.items()
+        )
+        re = tuple(
+            (n, t.random_effect_type, t.feature_shard_id,
+             tuple(t.weights.shape), str(t.weights.dtype))
+            for n, t in self.random.items()
+        )
+        return (fe, re)
+
+    def _values_only_delta(self, new: "CoefficientTables") -> bool:
+        """True when ``new`` differs from the live tables ONLY in
+        coefficient VALUES — same structure, same projectors, same
+        entity vocabularies. That is the condition under which a live
+        swap cannot tear: row codes stay valid across generations and
+        weights are the single changing operand (each reference
+        assignment is atomic)."""
+        if new.structure_key() != self.structure_key():
+            return False
+        for name, t in self.random.items():
+            src = new.random[name]
+            if src.entity_keys != t.entity_keys:
+                return False
+            if not np.array_equal(
+                np.asarray(src.proj), np.asarray(t.proj)
+            ):
+                return False
+        return True
+
+    def reload(self, model: GameModel, *, donate: bool = False) -> bool:
+        """Swap a refreshed model's coefficients into the live tables.
+
+        Returns True for a VALUES-ONLY refresh (same coordinates,
+        shapes, dtype, projectors, and entity vocabularies — the
+        daily-retrain case): each weight reference flips to the new
+        generation's device array and every compiled score program
+        keeps serving, since coefficients are traced operands. This
+        swap is safe AGAINST LIVE DISPATCH: an in-flight batch pins the
+        old buffers through its own references, row codes mean the same
+        thing in both generations (vocabularies are identical), and a
+        batch dispatched mid-swap at worst mixes generations ACROSS
+        coordinates for that one batch.
+
+        ``donate=True`` additionally routes each new weights array
+        through a donating jitted copy so XLA may write it into the OLD
+        buffer's HBM — use it for memory-constrained reloads, and ONLY
+        with the queue quiesced (``close()`` or between drives):
+        donation marks the old buffer deleted, which would poison a
+        concurrently dispatched batch.
+
+        Returns False for anything else — entity vocabulary or
+        projector changed, coordinates added/removed, shapes/dtype
+        moved: the tables are rebuilt wholesale, which is NOT safe
+        under live dispatch (quiesce first), and the caller must
+        rebuild its score programs if shapes changed.
+        """
+        new = CoefficientTables.from_game_model(model)
+        if not self._values_only_delta(new):
+            self.fixed = new.fixed
+            self.random = new.random
+            self.task = new.task
+            return False
+
+        def swap(old, src):
+            if donate:
+                return _device_swap(old, np.asarray(src))
+            return src
+
+        for name, t in self.fixed.items():
+            src = new.fixed[name]
+            t.weights = swap(t.weights, src.weights)
+            t.task = src.task
+        for name, t in self.random.items():
+            src = new.random[name]
+            t.weights = swap(t.weights, src.weights)
+            t.task = src.task
+        self.task = new.task
+        return True
+
+
+def build_index_maps_from_model(model_dir: str) -> dict[str, IndexMap]:
+    """Per-shard index maps recovered from a saved model's own records.
+
+    A standalone serving process has no training dataset to build index
+    maps from; the model directory itself names every feature the model
+    can use (each BayesianLinearModelAvro record keys coefficients by
+    (name, term)). The union of keys per feature shard, sorted, is a
+    complete and deterministic serving-side map — features the model
+    never weighted are absent, which is harmless: their coefficient is
+    zero either way.
+    """
+    from photon_tpu.io import avro
+    from photon_tpu.io.model_io import COEFFICIENTS, ID_INFO
+
+    shard_keys: dict[str, set] = {}
+    for kind in ("fixed-effect", "random-effect"):
+        base = os.path.join(model_dir, kind)
+        if not os.path.isdir(base):
+            continue
+        for name in sorted(os.listdir(base)):
+            info = os.path.join(base, name, ID_INFO)
+            with open(info) as f:
+                shard = f.read().strip().splitlines()[-1]
+            keys = shard_keys.setdefault(shard, set())
+            coef_dir = os.path.join(base, name, COEFFICIENTS)
+            if not os.path.isdir(coef_dir):
+                continue
+            for rec in avro.read_container_dir(coef_dir):
+                for ntv in rec["means"]:
+                    keys.add(make_feature_key(ntv["name"], ntv["term"]))
+                for ntv in rec.get("variances") or ():
+                    keys.add(make_feature_key(ntv["name"], ntv["term"]))
+    return {
+        shard: IndexMap({k: i for i, k in enumerate(sorted(keys))})
+        for shard, keys in shard_keys.items()
+    }

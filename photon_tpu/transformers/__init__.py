@@ -146,6 +146,31 @@ def make_submodel_scorer(sub_model, data: GameDataset,
     raise TypeError(f"unknown sub-model type: {sub_model}")
 
 
+def evaluate_scores(
+    data: GameDataset,
+    scores: Array,
+    evaluators: list[str | EvaluatorSpec] | None,
+) -> EvaluationResults | None:
+    """Evaluate raw model scores against a dataset's labels — the
+    GameTransformer validation path (:186-192), shared with the serving
+    batch route (cli/score.py) so both scoring implementations grade
+    through one suite construction."""
+    if not evaluators:
+        return None
+    suite = make_suite(
+        evaluators,
+        data.labels,
+        offsets=data.offsets,
+        weights=data.weights,
+        group_ids={
+            name: (tag.codes, tag.num_groups)
+            for name, tag in data.id_tags.items()
+        },
+        dtype=data.labels.dtype,
+    )
+    return suite.evaluate(scores)
+
+
 @dataclasses.dataclass(frozen=True)
 class GameTransformer:
     """Reference: transformers/GameTransformer.scala (transform :150-197)."""
@@ -176,17 +201,4 @@ class GameTransformer:
         """Score; optionally evaluate against the dataset's labels
         (GameTransformer validation path :186-192)."""
         scores = self.score(data)
-        if not evaluators:
-            return scores, None
-        suite = make_suite(
-            evaluators,
-            data.labels,
-            offsets=data.offsets,
-            weights=data.weights,
-            group_ids={
-                name: (tag.codes, tag.num_groups)
-                for name, tag in data.id_tags.items()
-            },
-            dtype=data.labels.dtype,
-        )
-        return scores, suite.evaluate(scores)
+        return scores, evaluate_scores(data, scores, evaluators)

@@ -2,6 +2,7 @@
 
 from photon_tpu.utils.compile_cache import (
     cache_stats,
+    compile_event_count,
     enable_compilation_cache,
 )
 from photon_tpu.utils.timed import Timed, profile_trace
@@ -9,6 +10,7 @@ from photon_tpu.utils.timed import Timed, profile_trace
 __all__ = [
     "Timed",
     "cache_stats",
+    "compile_event_count",
     "enable_compilation_cache",
     "profile_trace",
 ]

@@ -191,6 +191,22 @@ def _dir_stats(cache_dir: str) -> tuple[int, int]:
     return entries, total
 
 
+def compile_event_count() -> int:
+    """Total persistent-cache requests seen so far (hits + misses).
+
+    A DELTA of this across a window is the runtime zero-recompile
+    check the serving path uses: any compile attempted in the window —
+    whether the disk cache served it or not — moves the count, so a
+    steady-state loop that "adds zero programs" must leave it flat
+    (bench.py ``serving_compile_events``, cli/serve.py
+    ``compile_events_during_serving``). Only meaningful while the
+    persistent cache is enabled (the monitoring listener is installed
+    by ``enable_compilation_cache``).
+    """
+    with _lock:
+        return _stats["persistent_hits"] + _stats["persistent_misses"]
+
+
 def cache_stats() -> dict:
     """Hit/miss counters + on-disk footprint of the persistent cache.
 

@@ -327,7 +327,11 @@ def test_fused_seconds_attributed_from_measured_wall(tiny_glmix_fit):
     (fused,) = [s for s in spans if s.name == "fused_fit"]
     fit_seconds = fused.attrs["fit_seconds"]
     assert 0.0 < fit_seconds <= fused.seconds
-    assert sum(secs) == pytest.approx(fit_seconds, rel=1e-4)
+    # The span attr is rounded to 1e-6 (Span export contract) while the
+    # record shares carry full precision, so a sub-5ms fit window on a
+    # slow box can exceed a rel-only bound by the rounding quantum —
+    # allow that half-quantum absolutely.
+    assert sum(secs) == pytest.approx(fit_seconds, rel=1e-4, abs=5.1e-7)
     assert fused.device_wait_seconds is not None
 
 
