@@ -675,6 +675,7 @@ def run_serving() -> dict:
         programs, max_linger_s=SERVE_MAX_LINGER_MS / 1e3
     ) as queue:
         summary = drive(queue, requests)
+        health = queue.health()
     compile_events = compile_event_count() - before
     return {
         "serving_requests": summary["requests"],
@@ -692,7 +693,29 @@ def run_serving() -> dict:
         "serving_programs_compiled": programs.stats["programs_compiled"],
         "serving_ladder_compile_seconds": round(ladder_seconds, 3),
         "serving_compile_events": compile_events,
+        # Degraded-mode snapshot (resilience layer): on this CLEAN
+        # bench run every shed/deadline/retry/breaker counter must be
+        # zero — gated in serving_regressions.
+        "serving_health": health,
     }
+
+
+def resilience_regressions() -> list[str]:
+    """Clean-run resilience gate: the bench injects NO faults, so every
+    retry counter (and any CD rollback) recorded during the run means a
+    real transient failure — or a resilience-layer bug — either way a
+    regression to surface."""
+    from photon_tpu.resilience import retry_stats
+
+    out = []
+    stats = retry_stats()
+    for key in ("retries", "recovered", "exhausted"):
+        if stats.get(key, 0):
+            out.append(
+                f"clean bench run recorded {stats[key]} retry-layer "
+                f"{key} event(s) (expected zero without injected "
+                "faults)")
+    return out
 
 
 def serving_regressions(serving: dict) -> list[str]:
@@ -705,6 +728,14 @@ def serving_regressions(serving: dict) -> list[str]:
     if serving.get("serving_errors", 0) != 0:
         out.append(
             f"{serving['serving_errors']} serving request(s) errored")
+    health = serving.get("serving_health") or {}
+    for key in ("shed", "deadline_expired", "dispatch_retries",
+                "breaker_trips", "dispatch_errors"):
+        if health.get(key, 0) != 0:
+            out.append(
+                f"clean serving run recorded {health[key]} "
+                f"{key} event(s) (degraded-mode counters must be zero "
+                "without injected faults)")
     return out
 
 
@@ -1048,6 +1079,7 @@ def run_smoke() -> dict:
     # serve spans/metrics land in the smoke output's telemetry too.
     serving = run_serving()
     regressions.extend(serving_regressions(serving))
+    regressions.extend(resilience_regressions())
     for key in ("serving_p50_ms", "serving_p99_ms", "serving_qps"):
         if serving.get(key) is None:
             regressions.append(f"serving scenario missing {key}")
@@ -1141,6 +1173,7 @@ def main(argv=None):
             f"logistic_compile_seconds {logi['compile_seconds']:.1f} > "
             f"{FLOORS['logistic_compile_seconds_max']:.1f}")
     regressions.extend(serving_regressions(serving))
+    regressions.extend(resilience_regressions())
 
     out = {
         "metric": "glmix_logistic_train_throughput",

@@ -40,6 +40,45 @@ def _sub_add(total, old, new):
     return total - old + new
 
 
+@jax.jit
+def _all_finite(x):
+    """One tiny reduce per operand shape (jit caches per aval)."""
+    return jnp.all(jnp.isfinite(x))
+
+
+def _model_weight_arrays(model) -> list:
+    """The weight arrays a coordinate model carries (guard operands).
+
+    Knows the three shapes that flow through the CD loop: shard-tagged
+    FixedEffectModels (``.model`` is the GLM), RandomEffectModels
+    (``.coefficients`` is the padded table), and bare GLMs (direct CD
+    use in tests). Unknown types contribute nothing — the score check
+    still covers them.
+    """
+    glm = getattr(model, "model", model)
+    coefs = getattr(glm, "coefficients", None)
+    if coefs is None:
+        return []
+    means = getattr(coefs, "means", None)
+    if means is not None:
+        return [means]
+    return [coefs] if hasattr(coefs, "shape") else []
+
+
+def _update_is_finite(model, scores) -> bool:
+    """Host-side non-finite guard for one coordinate update.
+
+    This is a DELIBERATE host sync per update — the guard exists to
+    stop a poisoned iterate before it corrupts the residual total, and
+    only runs when ``non_finite_guard`` is enabled (the default loop
+    stays fully asynchronous).
+    """
+    for arr in [scores, *_model_weight_arrays(model)]:
+        if not bool(_all_finite(arr)):
+            return False
+    return True
+
+
 def _serialize_on_cpu_mesh(x) -> None:
     """Block on ``x`` when it lives on a multi-device CPU mesh.
 
@@ -112,6 +151,10 @@ class CoordinateUpdateRecord:
     seconds: float | None  # host dispatch time; None on the fused path
     diagnostics: Any
     evaluation: EvaluationResults | None
+    # Non-finite guard outcome: True when this update produced NaN/inf
+    # loss or weights and the loop kept the PREVIOUS iterate instead
+    # (the diagnostics are the poisoned update's, for debugging).
+    rolled_back: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,11 +180,19 @@ class CoordinateDescent:
         *,
         locked_coordinates: set[str] | None = None,
         emitter=None,
+        non_finite_guard: bool = False,
     ):
         # Optional event fan-out (photon_tpu.events.EventEmitter): a
         # CoordinateUpdateEvent after every coordinate update
         # (EventEmitter.scala:24 semantics, wired to the GAME path).
         self.emitter = emitter
+        # Resilience: when enabled, every coordinate update is checked
+        # for non-finite loss/weights/scores (one host sync per update)
+        # and a poisoned update ROLLS BACK to the previous iterate
+        # instead of corrupting the model (resilience layer;
+        # RESILIENCE.md). Off by default: the asynchronous dispatch
+        # pipeline is the performance contract of this loop.
+        self.non_finite_guard = bool(non_finite_guard)
         if num_iterations < 1:
             raise ValueError(f"num_iterations must be >= 1: {num_iterations}")
         seen = set()
@@ -166,13 +217,34 @@ class CoordinateDescent:
         validation: ValidationContext | None = None,
         *,
         seed: int = 0,
+        start_iteration: int = 0,
+        on_iteration=None,
+        initial_best=None,
     ) -> CoordinateDescentResult:
         """Train all coordinates by block coordinate descent.
 
         Mirrors CoordinateDescent.descend/descendWithValidation: coordinate k
         trains against offsets + (sum of all other coordinates' scores); its
         new scores replace its old ones in the running total.
+
+        ``start_iteration`` resumes mid-descent from a checkpoint:
+        iterations [0, start_iteration) are assumed done and baked into
+        ``initial_models`` — the loop runs [start_iteration,
+        num_iterations) with the SAME per-iteration seeds the
+        uninterrupted run would have used. ``initial_best`` — a
+        ``(model, evaluation)`` pair — seeds the best-by-validation
+        tracking on resume: without it a resumed run restarts best
+        selection from scratch and can silently return a worse model
+        than the uninterrupted run when the pre-crash best never
+        recurs. ``on_iteration(it, model, best_model)`` fires after
+        each completed outer iteration with the full GameModel and the
+        best-so-far (None until a full model has been evaluated) — the
+        training checkpointer's hook.
         """
+        if not 0 <= start_iteration <= self.num_iterations:
+            raise ValueError(
+                f"start_iteration {start_iteration} outside "
+                f"[0, {self.num_iterations}]")
         for cid in self.update_sequence:
             if cid not in coordinates:
                 raise KeyError(f"no coordinate for id {cid!r}")
@@ -204,18 +276,21 @@ class CoordinateDescent:
         history: list[CoordinateUpdateRecord] = []
         best_model: GameModel | None = None
         best_eval: EvaluationResults | None = None
+        if initial_best is not None:
+            best_model, best_eval = initial_best
         all_ids = set(self.update_sequence)
         val_scores: dict[str, Array] = {}
         val_total: Array | None = None
 
         from photon_tpu import obs
 
-        for it in range(self.num_iterations):
+        for it in range(start_iteration, self.num_iterations):
             for cid in self.update_sequence:
                 if cid in self.locked_coordinates:
                     continue
                 coord = coordinates[cid]
                 t0 = time.perf_counter()
+                rolled_back = False
                 # Telemetry span mirrors the measured dispatch window
                 # below (host-side only; the obs tree's unfused analog of
                 # the fused fit's single whole-fit span — no sync here:
@@ -233,15 +308,61 @@ class CoordinateDescent:
                     )
                     new_scores = coord.score(model)
                     _serialize_on_cpu_mesh(new_scores)
-                    # summedScores - oldScores + previousScores (:442,583).
-                    # One jitted program: each eager arithmetic op costs a
-                    # ~0.5s one-off compile on the tunneled TPU backend.
-                    if total is None:
+                    # Non-finite guard (resilience): catch a poisoned
+                    # update BEFORE it enters the residual total. The
+                    # rollback keeps the previous iterate for this
+                    # coordinate; total/scores stay untouched, so every
+                    # later update trains against the last good state.
+                    if self.non_finite_guard and not _update_is_finite(
+                        model, new_scores
+                    ):
+                        if cid not in models:
+                            from photon_tpu.resilience.errors import (
+                                NonFiniteUpdateError,
+                            )
+
+                            raise NonFiniteUpdateError(
+                                f"coordinate {cid!r} produced non-finite "
+                                f"loss/weights on its first update (CD "
+                                f"iteration {it}): no previous iterate "
+                                "to roll back to")
+                        rolled_back = True
+                    elif total is None:
+                        # summedScores - oldScores + previousScores
+                        # (:442,583). One jitted program: each eager
+                        # arithmetic op costs a ~0.5s one-off compile on
+                        # the tunneled TPU backend.
                         total = new_scores
                     elif cid in scores:
                         total = _sub_add(total, scores[cid], new_scores)
                     else:
                         total = total + new_scores
+                if rolled_back:
+                    logger.warning(
+                        "CD iter %d coordinate %s: non-finite update "
+                        "ROLLED BACK to the previous iterate", it, cid)
+                    if obs.enabled():
+                        obs.REGISTRY.counter(
+                            "coordinate_rollbacks_total", coordinate=cid
+                        ).inc()
+                    record = CoordinateUpdateRecord(
+                        iteration=it,
+                        coordinate_id=cid,
+                        seconds=time.perf_counter() - t0,
+                        diagnostics=diag,
+                        evaluation=None,
+                        rolled_back=True,
+                    )
+                    history.append(record)
+                    if self.emitter is not None:
+                        from photon_tpu.events import (
+                            CoordinateRollbackEvent,
+                        )
+
+                        self.emitter.send_event(
+                            CoordinateRollbackEvent(record)
+                        )
+                    continue
                 models[cid] = model
                 scores[cid] = new_scores
                 seconds = time.perf_counter() - t0
@@ -299,6 +420,16 @@ class CoordinateDescent:
                     from photon_tpu.events import CoordinateUpdateEvent
 
                     self.emitter.send_event(CoordinateUpdateEvent(record))
+            # End of one OUTER iteration: the crash-safe recovery point.
+            # The checkpointer hook runs first (state committed), then
+            # the `cd.iteration` injection point — so an injected crash
+            # here simulates dying with iteration `it`'s checkpoint
+            # already durable, the kill-and-resume chaos window.
+            if on_iteration is not None:
+                on_iteration(it, GameModel(dict(models)), best_model)
+            from photon_tpu.resilience import faults
+
+            faults.check("cd.iteration")
 
         final = GameModel(dict(models))
         if best_model is None:

@@ -1010,22 +1010,64 @@ class FusedFit:
             # AOT compile wait, and slab materialization above are not
             # fit work and must not be charged to coordinate records.
             t_fit0 = time.perf_counter()
-            out = None
             fit_window_pure = True
-            if aot is not None and statics == aot.get("statics"):
-                try:
-                    out = aot["fit"](ops, ebs_all)
-                except Exception:  # noqa: BLE001 — stale shape prediction
-                    logger.info(
-                        "ingest pipeline: AOT fit executable incompatible "
-                        "with the built datasets; recompiling")
-                    self._aot = None
-            if out is None:
-                # A first jit-fallback entry traces + compiles inside
-                # the window: not pure fit execution (see _jit_seen).
-                fit_window_pure = statics in self._jit_seen
-                out = self._jit(ops, ebs_all, statics=statics)
-                self._jit_seen.add(statics)
+
+            def dispatch_once():
+                # The `fit.dispatch` injection point fires BEFORE any
+                # executable is entered, so an injected transient fault
+                # exercises the retry path without touching device
+                # state; the retry wrapper re-runs this whole selection
+                # (AOT-or-jit), which is idempotent — operands are
+                # unchanged and both paths are pure dispatches.
+                from photon_tpu.resilience import faults
+
+                nonlocal fit_window_pure
+                faults.check("fit.dispatch")
+                res = None
+                if aot is not None and statics == aot.get("statics"):
+                    try:
+                        res = aot["fit"](ops, ebs_all)
+                    except Exception as exc:  # noqa: BLE001 — stale shape prediction
+                        from photon_tpu.resilience import errors
+
+                        if errors.is_transient(exc):
+                            # A real backend fault (UNAVAILABLE /
+                            # preempted), not a stale prediction: let
+                            # the retry wrapper classify and re-enter —
+                            # the executable is fine, dropping it would
+                            # pay a jit fallback on every later fit and
+                            # record zero retry stats for a real fault.
+                            raise
+                        logger.info(
+                            "ingest pipeline: AOT fit executable "
+                            "incompatible with the built datasets; "
+                            "recompiling")
+                        self._aot = None
+                if res is None:
+                    # A first jit-fallback entry traces + compiles inside
+                    # the window: not pure fit execution (see _jit_seen).
+                    # AND (not assign): a retried second attempt would
+                    # find statics in _jit_seen and flip a window that
+                    # already contained attempt 1's trace back to pure.
+                    fit_window_pure = (
+                        fit_window_pure and statics in self._jit_seen
+                    )
+                    res = self._jit(ops, ebs_all, statics=statics)
+                    self._jit_seen.add(statics)
+                return res
+
+            def _mark_impure(attempt, exc):
+                # Any retry puts a failed attempt + the backoff sleep
+                # inside the t_fit0 window — never attribute it.
+                nonlocal fit_window_pure
+                fit_window_pure = False
+
+            from photon_tpu.resilience import retry
+
+            out = retry.call_with_retry(
+                dispatch_once, site="fused_fit.dispatch",
+                on_retry=_mark_impure,
+            )
             states, scores, total, packed_flat, conv = out
             if sp is not None:
                 sp.sync = out

@@ -84,6 +84,7 @@ DECLARING_MODULES = (
     "photon_tpu.obs",
     "photon_tpu.ops.newton_kernel",
     "photon_tpu.parallel.mesh",
+    "photon_tpu.resilience",
     "photon_tpu.serve",
 )
 
@@ -1134,6 +1135,98 @@ def build_serving() -> ContractTrace:
     )
 
 
+def build_resilience() -> ContractTrace:
+    """The resilience layer's zero-program-footprint contract.
+
+    ``call_with_retry`` and ``faults.check`` are HOST machinery wrapped
+    around already-built executables — they must never alter what gets
+    traced. Proof by construction: one serving score program (a tiny
+    GLMix structure, single rung) is the base; the SAME trace is then
+    taken (a) from inside a ``call_with_retry`` wrapper and (b) with a
+    full-coverage armed ``FaultPlan`` whose triggers can never fire
+    (``nth`` beyond any call count) — both must be byte-identical to
+    the base signature. The ``hot_loop`` walk additionally proves no
+    callback primitive entered the jaxpr (a retry layer implemented as
+    an in-trace ``pure_callback`` would fail here, which is the point).
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.resilience import FaultPlan, call_with_retry, faults
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+    from photon_tpu.types import TaskType
+
+    d, e, s, du = 4, 5, 2, 4
+    rng = np.random.default_rng(20260803)
+    proj = np.stack([
+        np.sort(rng.permutation(du)[:s]) for _ in range(e)
+    ]).astype(np.int64)
+    model = GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(means=jnp.asarray(
+                    rng.normal(size=d).astype(np.float32)
+                )),
+                TaskType.LOGISTIC_REGRESSION,
+            ),
+            "features",
+        ),
+        "per-user": RandomEffectModel(
+            coefficients=jnp.asarray(
+                rng.normal(size=(e, s)).astype(np.float32)
+            ),
+            random_effect_type="userId",
+            feature_shard_id="userShard",
+            task=TaskType.LOGISTIC_REGRESSION,
+            proj_all=proj,
+            entity_keys=tuple(str(i) for i in range(e)),
+        ),
+    })
+    tables = CoefficientTables.from_game_model(model)
+    programs = ScorePrograms(
+        tables, ladder=ShapeLadder((8,)), compile_now=False
+    )
+
+    def trace_once() -> TracedProgram:
+        traced = programs.trace(8)
+        return TracedProgram(
+            name="score_b8",
+            text=str(traced.jaxpr),
+            jaxpr=traced.jaxpr,
+            lowered=traced.lower(),
+        )
+
+    base = trace_once()
+    wrapped = call_with_retry(trace_once, site="audit.resilience")
+    # Full coverage, unreachable triggers: arming must be invisible to
+    # tracing (the hooks are host-side, outside any trace).
+    plan = FaultPlan(
+        [dict(point=p, nth=10**9) for p in faults.INJECTION_POINTS],
+        seed=0,
+    )
+    with faults.injected(plan):
+        armed = trace_once()
+    return ContractTrace(
+        programs={"score_b8": base},
+        variants={
+            "retry_wrap": [{"score_b8": wrapped.signature}],
+            "fault_plan_armed": [{"score_b8": armed.signature}],
+        },
+        notes=[
+            "retry wrapper + armed FaultPlan trace byte-identical "
+            "programs: the resilience layer is host-level only",
+        ],
+    )
+
+
 def build_evaluators() -> ContractTrace:
     """Evaluation + scoring entry points: shape-specialized (a row-count
     change recompiles, by design), value-stable, no host callbacks."""
@@ -1182,6 +1275,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_ingest_pipeline": build_ingest_pipeline,
     "build_telemetry": build_telemetry,
     "build_serving": build_serving,
+    "build_resilience": build_resilience,
     "build_evaluators": build_evaluators,
 }
 

@@ -51,6 +51,18 @@ def main(argv=None) -> int:
                              "batch-mates before a flush")
     parser.add_argument("--max-queue", type=int, default=4096,
                         help="queue bound; producers block beyond it")
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        help="per-request deadline: a request still "
+                             "queued past it fails fast with "
+                             "DeadlineExceededError (RESILIENCE.md)")
+    parser.add_argument("--shed-watermark", type=int, default=None,
+                        help="queue depth beyond which submits are "
+                             "rejected (OverloadedError) instead of "
+                             "blocking")
+    parser.add_argument("--breaker-threshold", type=int, default=8,
+                        help="consecutive dispatch failures that trip "
+                             "the circuit breaker (drain + fail fast); "
+                             "0 disables")
     parser.add_argument("--target-qps", type=float, default=None,
                         help="pace submissions at this offered load "
                              "(default: flood — closed-loop saturation)")
@@ -80,8 +92,13 @@ def main(argv=None) -> int:
     from photon_tpu.cli.common import cli_logging
 
     with cli_logging(args.verbose, args.log_file):
+        from photon_tpu.resilience import faults
         from photon_tpu.utils import enable_compilation_cache
 
+        # Chaos harness: PHOTON_TPU_FAULT_PLAN arms a seeded FaultPlan
+        # inside this process (no-op when unset) — how the chaos-smoke
+        # CI injects faults into CLI subprocesses deterministically.
+        faults.arm_from_env()
         # Warm server starts skip the ladder compiles entirely: the AOT
         # programs key into the same persistent cache as everything else.
         enable_compilation_cache()
@@ -192,8 +209,15 @@ def _run_instrumented(args, obs, compile_event_count) -> int:
             max_batch=args.max_batch,
             max_linger_s=args.max_linger_ms / 1e3,
             max_queue=args.max_queue,
+            default_deadline_s=(
+                None if args.deadline_ms is None
+                else args.deadline_ms / 1e3
+            ),
+            shed_watermark=args.shed_watermark,
+            breaker_threshold=args.breaker_threshold or None,
         ) as queue:
             summary = drive(queue, requests, rate=args.target_qps)
+            health = queue.health()
     after = compile_event_count()
 
     out = {
@@ -208,6 +232,9 @@ def _run_instrumented(args, obs, compile_event_count) -> int:
         ),
         "dispatches": programs.stats["dispatches"],
         "compile_events_during_serving": after - before,
+        # Degraded-mode snapshot (queue depth, shed/deadline/breaker/
+        # retry counters, table generation) — what a health probe reads.
+        "health": health,
     }
     out.update(summary)
     if args.telemetry:

@@ -30,6 +30,16 @@ def main(argv=None) -> int:
                         help="YAML/JSON training configuration")
     parser.add_argument("--backend", default=None,
                         help="JAX platform override (tpu, cpu, axon, ...)")
+    parser.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                        help="crash-safe training checkpoints: commit "
+                             "an atomic recovery point (model npz + "
+                             "manifest) after every outer CD iteration "
+                             "(RESILIENCE.md)")
+    parser.add_argument("--resume", default=None, metavar="DIR",
+                        help="resume an interrupted run from DIR's "
+                             "checkpoint (implies --checkpoint-dir DIR; "
+                             "the manifest's config static key must "
+                             "match this run's configuration)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("--log-file", default=None,
                         help="also write logs to this file (PhotonLogger "
@@ -42,14 +52,30 @@ def main(argv=None) -> int:
                              "Resets the process's telemetry stream: "
                              "the run owns its stream end to end")
     args = parser.parse_args(argv)
+    if (args.resume and args.checkpoint_dir
+            and os.path.abspath(args.resume)
+            != os.path.abspath(args.checkpoint_dir)):
+        # A divergent pair would load the manifest from --resume but look
+        # up config-final/best artifacts in --checkpoint-dir, silently
+        # resuming without them.
+        parser.error(
+            "--resume and --checkpoint-dir point at different "
+            f"directories ({args.resume} vs {args.checkpoint_dir}); "
+            "--resume DIR already implies --checkpoint-dir DIR")
 
     if args.backend:
         os.environ["JAX_PLATFORMS"] = args.backend
     from photon_tpu.cli.common import cli_logging, maybe_init_distributed
 
     with cli_logging(args.verbose, args.log_file):
+        from photon_tpu.resilience import faults
         from photon_tpu.utils import enable_compilation_cache
 
+        # Chaos harness: PHOTON_TPU_FAULT_PLAN arms a seeded FaultPlan
+        # inside this process (no-op when unset) — how the chaos-smoke
+        # CI and the kill/resume tests inject faults into a real
+        # training subprocess deterministically.
+        faults.arm_from_env()
         enable_compilation_cache()  # persistent XLA cache: warm runs skip compiles
         maybe_init_distributed()
         if args.telemetry:
@@ -363,13 +389,76 @@ def _run(args) -> int:
     estimator = cfg.build_estimator(norm_contexts, intercept_indices)
     opt_seq = cfg.opt_config_sequence()
     log.info("training %d configuration(s)", len(opt_seq))
-    with obs.logged_span("prepare training datasets", log):
-        estimator.prepare(train, validation, initial_model)
-    with obs.logged_span("train models", log), \
-            profile_trace(cfg.profile_dir):
-        results = estimator.fit(
-            train, validation, opt_seq, initial_model=initial_model
+
+    # ------------------------------------------------------------------
+    # crash safety (photon_tpu.resilience; RESILIENCE.md)
+    # ------------------------------------------------------------------
+    checkpointer = None
+    resume_state = None
+    ckpt_dir = args.checkpoint_dir or args.resume
+    if ckpt_dir:
+        from photon_tpu.resilience import (
+            TrainingCheckpointer,
+            load_training_checkpoint,
+            training_static_key,
         )
+
+        static_key = training_static_key(estimator, opt_seq)
+        checkpointer = TrainingCheckpointer(ckpt_dir, static_key)
+        if args.resume:
+            resume_state = load_training_checkpoint(args.resume)
+            log.info(
+                "resuming from %s: config %d, last completed CD "
+                "iteration %d%s", args.resume,
+                resume_state.config_index, resume_state.iteration,
+                " (interrupted run)" if resume_state.interrupted else "")
+
+    # SIGINT/SIGTERM: unwind the fit via TrainingInterrupted so a final
+    # emergency checkpoint lands before the nonzero exit — a preempted
+    # host resumes instead of restarting from scratch. Installed only
+    # around the training section (the handlers are process-global
+    # state; an embedding process gets them back in the finally).
+    import signal
+
+    def _interrupt(signum, frame):
+        raise TrainingInterrupted(signum)
+
+    from photon_tpu.resilience import TrainingInterrupted
+
+    prev_handlers = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            prev_handlers[sig] = signal.signal(sig, _interrupt)
+        except ValueError:  # pragma: no cover — non-main-thread embed
+            pass
+    try:
+        with obs.logged_span("prepare training datasets", log):
+            estimator.prepare(train, validation, initial_model)
+        with obs.logged_span("train models", log), \
+                profile_trace(cfg.profile_dir):
+            results = estimator.fit(
+                train, validation, opt_seq,
+                initial_model=initial_model,
+                checkpointer=checkpointer,
+                resume=resume_state,
+            )
+    except TrainingInterrupted as exc:
+        log.error("training interrupted by signal %d", exc.signum)
+        if checkpointer is not None:
+            path = checkpointer.write_emergency()
+            if path:
+                log.error(
+                    "emergency checkpoint committed to %s; resume "
+                    "with: photon train --config %s --resume %s",
+                    path, args.config, ckpt_dir)
+            else:
+                log.error(
+                    "interrupted before any CD iteration completed; "
+                    "no training state to checkpoint")
+        return 128 + exc.signum
+    finally:
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
 
     # ------------------------------------------------------------------
     # hyperparameter tuning (runHyperparameterTuning :677-719)

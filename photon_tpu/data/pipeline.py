@@ -159,7 +159,13 @@ class _Pool:
                     max_workers=self._resolve_workers(),
                     thread_name_prefix=self._name,
                 )
-        return self._pool.submit(fn, *args, **kwargs)
+            # Submit INSIDE the lock: shutdown() swaps the pool out
+            # under this lock before shutting it down, so a submit that
+            # escaped the critical section could land on an executor
+            # already past shutdown ("cannot schedule new futures").
+            # Executor.submit is a quick enqueue; the blocking
+            # shutdown(wait=True) stays outside the lock.
+            return self._pool.submit(fn, *args, **kwargs)
 
     def shutdown(self) -> None:
         with self._lock:
@@ -384,6 +390,12 @@ def map_chunked(fn, out: np.ndarray, *arrays: np.ndarray) -> np.ndarray:
         return out
 
     def run(lo: int, hi: int) -> None:
+        from photon_tpu.resilience import faults
+
+        # Chaos boundary: a chunk worker dying mid-pass must surface
+        # through consume_futures (first exception re-raised after all
+        # complete), never silently zero a span of the output.
+        faults.check("ingest.chunk")
         out[lo:hi] = fn(*[a[lo:hi] for a in arrays])
 
     consume_futures(
@@ -498,7 +510,23 @@ def packed_device_put(arrays) -> tuple:
     donated concatenate restores the ONE contiguous buffer every packed
     consumer slices at static offsets (the layout contract is unchanged —
     byte-identical to the single-shot buffer).
+
+    The transfer is a RETRIED site (resilience layer): a transient
+    host->device failure — preemption blips, the injected
+    ``transfer.packed`` fault — re-runs the whole put (it is pure: host
+    arrays in, fresh device buffer out), with backoff; stage seconds
+    accumulate across attempts because the time was really spent.
     """
+    from photon_tpu.resilience import retry
+
+    return retry.retrying_check(
+        "transfer.packed",
+        lambda: _packed_device_put_once(arrays),
+        site="ingest.packed_transfer",
+    )
+
+
+def _packed_device_put_once(arrays) -> tuple:
     import jax
 
     shapes = tuple(a.shape for a in arrays)

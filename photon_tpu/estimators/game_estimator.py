@@ -234,7 +234,11 @@ class GameFitResult:
     model: GameModel  # best-by-validation model of this config's CD run
     config: dict[str, GLMOptimizationConfiguration]
     evaluation: EvaluationResults | None
-    descent: CoordinateDescentResult
+    # None for a completed-config result rebuilt on resume (the config
+    # ran to completion in the interrupted process; its per-update
+    # history died with it — model/evaluation are reconstructed from
+    # the retained config-final checkpoint).
+    descent: CoordinateDescentResult | None
 
 
 def _log_orphaned_compile(fut) -> None:
@@ -270,6 +274,7 @@ class GameEstimator:
         incremental_training: bool = False,
         mesh="auto",
         listeners=None,
+        non_finite_guard: bool = False,
     ):
         self.task = task
         self.coordinate_configs = dict(coordinate_configs)
@@ -298,6 +303,10 @@ class GameEstimator:
         # Pass "off"/None for single-device, or a jax.sharding.Mesh / device
         # count to control placement explicitly.
         self.mesh = mesh
+        # Resilience: per-update NaN/inf guard with rollback in the CD
+        # loop (needs a host boundary per update, so it rides the
+        # unfused path — see fit()'s fused gating).
+        self.non_finite_guard = bool(non_finite_guard)
         # Training-event fan-out (events.EventEmitter listener registry):
         # CoordinateUpdateEvent per coordinate update, FitEndEvent per
         # optimization config (EventEmitter.scala:24 for the GAME path).
@@ -339,6 +348,11 @@ class GameEstimator:
         mesh = self.resolve_mesh()
 
         def build_one(cid: str, cfg):
+            from photon_tpu.resilience import faults
+
+            # Chaos boundary: a planner thunk dying on the plan pool
+            # must propagate through consume_futures, not hang the fit.
+            faults.check("ingest.plan")
             if isinstance(cfg, RandomEffectCoordinateConfiguration):
                 extra = None
                 if initial_model is not None and cid in initial_model:
@@ -812,6 +826,93 @@ class GameEstimator:
                 )
         return ValidationContext(suite=suite, scorers=scorers)
 
+    @staticmethod
+    def _score_with_validation(val_ctx, model):
+        """Rescore a (re)loaded model against the validation set — same
+        model, same scores, so it reproduces a previously recorded
+        metric to float-reassociation tolerance."""
+        total = None
+        for cid, m in model.items():
+            vs = val_ctx.scorers[cid](m)
+            total = vs if total is None else total + vs
+        return val_ctx.suite.evaluate(total)
+
+    def _full_config(self, opt_configs):
+        return {
+            cid: opt_configs.get(
+                cid, self.coordinate_configs[cid].optimization)
+            for cid in self.update_sequence
+        }
+
+    def _rebuild_completed_config(
+        self, checkpointer, resume, i, opt_configs, val_ctx
+    ) -> GameFitResult:
+        """Rebuild a completed config's result from its retained
+        config-final checkpoint (resume path). The model is the best
+        model that config committed; the evaluation is recomputed by
+        rescoring it against the validation set."""
+        from photon_tpu.resilience.checkpoint import load_config_final
+
+        directory = self._checkpoint_directory(checkpointer, resume)
+        model = load_config_final(directory, i, resume.static_key)
+        return GameFitResult(
+            model=model,
+            config=self._full_config(opt_configs),
+            evaluation=(
+                self._score_with_validation(val_ctx, model)
+                if val_ctx is not None else None
+            ),
+            descent=None,
+        )
+
+    def _finalize_from_checkpoint(
+        self, checkpointer, resume, i, opt_configs, val_ctx
+    ) -> GameFitResult:
+        """The crash window AFTER a config's last-iteration checkpoint
+        committed but BEFORE its config-final artifact was retained:
+        the descent finished (the chain holds iteration
+        num_iterations-1), so rebuild the result from the chain itself —
+        the final model IS the checkpoint's, the best-by-validation
+        comes from the retained best artifact — and heal the missing
+        config-final so later resumes take the normal path. Without
+        this, a valid checkpoint is refused with 'nothing to resume' /
+        'retrain from scratch' even though the run produced no results."""
+        from photon_tpu.resilience.checkpoint import load_config_best
+
+        directory = self._checkpoint_directory(checkpointer, resume)
+        best_model = None
+        if val_ctx is not None:
+            best_model = load_config_best(
+                directory, i, resume.static_key
+            )
+        if best_model is None:
+            best_model = resume.model
+        logger.info(
+            "GameEstimator: config %d completed its descent before the "
+            "interruption but never retained its final artifact; "
+            "finalizing it from the checkpoint chain", i)
+        result = GameFitResult(
+            model=best_model,
+            config=self._full_config(opt_configs),
+            evaluation=(
+                self._score_with_validation(val_ctx, best_model)
+                if val_ctx is not None else None
+            ),
+            descent=None,
+        )
+        if checkpointer is not None:
+            checkpointer.save_config_final(best_model, config_index=i)
+        return result
+
+    @staticmethod
+    def _checkpoint_directory(checkpointer, resume) -> str:
+        import os
+
+        return (
+            checkpointer.directory if checkpointer is not None
+            else os.path.dirname(resume.path)
+        )
+
     # ------------------------------------------------------------------
     # fit (GameEstimator.scala:397)
     # ------------------------------------------------------------------
@@ -888,6 +989,9 @@ class GameEstimator:
             list[dict[str, GLMOptimizationConfiguration]] | None
         ) = None,
         initial_model: GameModel | None = None,
+        *,
+        checkpointer=None,
+        resume=None,
     ) -> list[GameFitResult]:
         """Train one GAME model per optimization configuration.
 
@@ -895,6 +999,27 @@ class GameEstimator:
         (GameEstimator.train :452-468); ``initial_model`` seeds the first
         (warm-start / partial-retrain model loading,
         GameTrainingDriver.scala:395-404).
+
+        ``checkpointer`` (a ``resilience.TrainingCheckpointer``) commits
+        a crash-safe recovery point after every outer CD iteration;
+        ``resume`` (a ``resilience.TrainingCheckpoint``) restarts
+        mid-descent from one — the manifest's static key must match this
+        estimator + config sequence (``ResumeMismatchError`` otherwise),
+        completed configs are skipped, and the in-progress config
+        continues at its next iteration with the SAME per-iteration
+        seeds, so the resumed run converges to the uninterrupted run's
+        model (within float reassociation tolerance; the initial score
+        total is re-accumulated in sequence order on resume).
+        Best-by-validation selection survives the crash too: the best
+        model is retained as its own checkpoint artifact and reseeds
+        CD's tracking on resume, and a config whose descent finished
+        but whose final artifact was never retained (the crash window
+        before ``save_config_final``) is finalized from the checkpoint
+        chain instead of being refused.
+        Checkpointing needs a host boundary per outer iteration, so an
+        active checkpointer (or resume, or the non-finite guard) rides
+        the unfused CD loop — crash safety trades away the whole-fit
+        fused program by design.
         """
         if self.incremental_training:
             self._validate_incremental(initial_model)
@@ -903,6 +1028,51 @@ class GameEstimator:
         )
         if opt_config_sequence is None:
             opt_config_sequence = [{}]
+
+        start_config = 0
+        resume_iteration = 0
+        if resume is not None:
+            from photon_tpu.resilience.checkpoint import (
+                training_static_key,
+            )
+            from photon_tpu.resilience.errors import ResumeMismatchError
+
+            expected = training_static_key(self, opt_config_sequence)
+            if resume.static_key != expected:
+                raise ResumeMismatchError(
+                    "checkpoint was written by a different training "
+                    f"configuration (manifest static key "
+                    f"{resume.static_key[:12]}..., this run "
+                    f"{expected[:12]}...): change the config back, or "
+                    "start fresh / warm-start instead of resuming")
+            start_config = resume.config_index
+            resume_iteration = resume.iteration + 1
+            if resume_iteration >= self.num_iterations:
+                start_config += 1
+                resume_iteration = 0
+            if start_config >= len(opt_config_sequence):
+                from photon_tpu.resilience.checkpoint import (
+                    has_config_final,
+                )
+
+                if has_config_final(
+                    self._checkpoint_directory(checkpointer, resume),
+                    len(opt_config_sequence) - 1,
+                ):
+                    raise ValueError(
+                        "checkpoint records the final configuration's "
+                        "last iteration: training already completed; "
+                        "nothing to resume")
+                # The crash landed between the final config's last-
+                # iteration checkpoint and its config-final retention:
+                # nothing descends, but every config's result still
+                # rebuilds below (the last one finalizing from the
+                # checkpoint chain itself) — refusing here would strand
+                # a run that produced no results behind 'nothing to
+                # resume'.
+            # The checkpoint model carries the full mid-descent state —
+            # it supersedes any initial_model for the warm-start chain.
+            initial_model = resume.model
 
         # Externally loaded RE models carry their own entity vocab / slot
         # layout; remap each ONCE onto this dataset's layout — the result
@@ -941,14 +1111,53 @@ class GameEstimator:
         results: list[GameFitResult] = []
         prev_model: GameModel | None = initial_model
         primed = False
+        # Crash safety needs a host boundary after every outer CD
+        # iteration (the checkpoint write / the non-finite guard's
+        # sync); the fused whole-fit program has none until the fit
+        # completes, so these features ride the unfused loop.
+        needs_host_boundary = (
+            checkpointer is not None
+            or resume is not None
+            or self.non_finite_guard
+        )
         for i, opt_configs in enumerate(opt_config_sequence):
+            if i < start_config:
+                # Completed before the interruption: rebuild its result
+                # from the retained config-final artifact so the
+                # returned list lines up with the FULL grid — otherwise
+                # select_best / tuning observations / per-index artifact
+                # writes silently shift and the resumed run can pick a
+                # different "best" model than the uninterrupted one.
+                # The config the checkpoint chain itself completed may
+                # have died before retaining its final — finalize it
+                # from the chain instead of refusing the resume.
+                from photon_tpu.resilience.checkpoint import (
+                    has_config_final,
+                )
+
+                if (
+                    i == resume.config_index
+                    and resume.iteration + 1 >= self.num_iterations
+                    and not has_config_final(
+                        self._checkpoint_directory(checkpointer, resume),
+                        i,
+                    )
+                ):
+                    results.append(self._finalize_from_checkpoint(
+                        checkpointer, resume, i, opt_configs, val_ctx
+                    ))
+                else:
+                    results.append(self._rebuild_completed_config(
+                        checkpointer, resume, i, opt_configs, val_ctx
+                    ))
+                continue
             coords = self._build_coordinates(
                 datasets, opt_configs, priors,
                 logical_rows=data.num_samples,
             )
             fused = (
                 self._fused_for(coords, datasets)
-                if val_ctx is None else None
+                if val_ctx is None and not needs_host_boundary else None
             )
             if fused is None and not primed:
                 self._prime_compilations(coords, datasets)
@@ -958,6 +1167,7 @@ class GameEstimator:
                 self.num_iterations,
                 locked_coordinates=self.locked_coordinates,
                 emitter=self.emitter,
+                non_finite_guard=self.non_finite_guard,
             )
             initial_models = {}
             if prev_model is not None:
@@ -987,6 +1197,51 @@ class GameEstimator:
             # independent across the lambda-config grid.
             from photon_tpu import obs
 
+            # Resuming mid-config with validation: seed CD's best
+            # tracking from the retained best artifact — the iteration
+            # chain holds final-iteration state, and restarting best
+            # selection from scratch would discard a pre-crash best
+            # that never recurs (silently returning a worse model than
+            # the uninterrupted run). The evaluation is recovered by
+            # rescoring the loaded best.
+            initial_best = None
+            if (
+                resume is not None
+                and i == start_config
+                and resume_iteration > 0
+                and val_ctx is not None
+            ):
+                from photon_tpu.resilience.checkpoint import (
+                    load_config_best,
+                )
+
+                best = load_config_best(
+                    self._checkpoint_directory(checkpointer, resume),
+                    i, resume.static_key,
+                )
+                if best is not None:
+                    initial_best = (
+                        best, self._score_with_validation(val_ctx, best)
+                    )
+
+            on_iteration = None
+            if checkpointer is not None:
+                # The best artifact commits BEFORE the iteration's
+                # manifest: a crash in between leaves a best at most
+                # one replayed iteration ahead of the cursor, which the
+                # resumed replay regenerates (same seeds). Identity
+                # tracking skips the write when the best didn't change.
+                _saved_best = [
+                    initial_best[0] if initial_best is not None else None
+                ]
+
+                def on_iteration(it, model, best, _ci=i):
+                    if best is not None and best is not _saved_best[0]:
+                        checkpointer.save_best(best, config_index=_ci)
+                        _saved_best[0] = best
+                    checkpointer.save(
+                        model, config_index=_ci, iteration=it
+                    )
             with obs.span(f"fit/config:{i}"):
                 if fused is not None:
                     descent = fused.run(coords, initial_models or None)
@@ -994,11 +1249,13 @@ class GameEstimator:
                     descent = cd.run(
                         coords, initial_models or None, val_ctx,
                         seed=i * self.num_iterations,
+                        start_iteration=(
+                            resume_iteration if i == start_config else 0
+                        ),
+                        on_iteration=on_iteration,
+                        initial_best=initial_best,
                     )
-            full_config = {
-                cid: opt_configs.get(cid, self.coordinate_configs[cid].optimization)
-                for cid in self.update_sequence
-            }
+            full_config = self._full_config(opt_configs)
             result = GameFitResult(
                 model=descent.best_model,
                 config=full_config,
@@ -1006,6 +1263,13 @@ class GameEstimator:
                 descent=descent,
             )
             results.append(result)
+            if checkpointer is not None:
+                # Retain this config's BEST model so a later resume can
+                # rebuild this result (the per-iteration chain holds
+                # final-iteration state, not best-by-validation).
+                checkpointer.save_config_final(
+                    descent.best_model, config_index=i
+                )
             if self.emitter is not None:
                 from photon_tpu.events import FitEndEvent
 

@@ -84,6 +84,25 @@ class CoordinateUpdateEvent(PhotonEvent):
 
 
 @dataclasses.dataclass(frozen=True)
+class CoordinateRollbackEvent(PhotonEvent):
+    """A coordinate update produced non-finite loss/weights and was
+    ROLLED BACK to the previous iterate (the CD loop's non-finite
+    guard, resilience layer): the model the run carries forward is the
+    pre-update one, and the wrapped record's ``rolled_back`` flag is
+    set. The poisoned update's diagnostics ride along for debugging."""
+
+    record: Any  # CoordinateUpdateRecord (rolled_back=True)
+
+    @property
+    def iteration(self) -> int:
+        return self.record.iteration
+
+    @property
+    def coordinate_id(self) -> str:
+        return self.record.coordinate_id
+
+
+@dataclasses.dataclass(frozen=True)
 class FitEndEvent(PhotonEvent):
     """One optimization configuration's coordinate-descent run finished
     (the per-config result of GameEstimator.fit :458)."""

@@ -24,6 +24,8 @@ the name-keyed Avro round trip.
 
 from __future__ import annotations
 
+import hashlib
+import io
 import json
 import os
 
@@ -32,6 +34,7 @@ import numpy as np
 
 from photon_tpu.data.index_map import IndexMap
 from photon_tpu.io import avro
+from photon_tpu.resilience.errors import CorruptModelError
 from photon_tpu.models.game import (
     FixedEffectModel,
     GameModel,
@@ -273,6 +276,24 @@ def model_feature_shard_ids(model_dir: str) -> set[str]:
     return shards
 
 
+def _read_coefficients_dir(coef_dir: str, what: str) -> list:
+    """Avro coefficient read with codec failures translated.
+
+    A truncated upload / torn copy otherwise surfaces as a bare
+    ``EOFError("truncated varint")`` with no hint WHICH of the model's
+    many part files is bad; every decode failure becomes a
+    ``CorruptModelError`` naming the directory and the cause.
+    """
+    try:
+        return avro.read_container_dir(coef_dir)
+    except (ValueError, EOFError, KeyError) as exc:
+        raise CorruptModelError(
+            f"{what} coefficients under {coef_dir}: Avro decode failed "
+            f"({type(exc).__name__}: {exc}) — the file is truncated or "
+            "not a BayesianLinearModelAvro container"
+        ) from exc
+
+
 def load_game_model(
     input_dir: str,
     index_maps: dict[str, IndexMap],
@@ -283,8 +304,14 @@ def load_game_model(
     padded-matrix layout with per-entity projectors derived from each
     entity's saved support.
     """
-    with open(os.path.join(input_dir, METADATA_FILE)) as f:
-        metadata = json.load(f)
+    meta_path = os.path.join(input_dir, METADATA_FILE)
+    try:
+        with open(meta_path) as f:
+            metadata = json.load(f)
+    except json.JSONDecodeError as exc:
+        raise CorruptModelError(
+            f"model metadata {meta_path}: not valid JSON ({exc})"
+        ) from exc
     task = TaskType(metadata["modelType"])
     models: dict[str, object] = {}
 
@@ -295,8 +322,9 @@ def load_game_model(
             with open(os.path.join(base, ID_INFO)) as f:
                 shard = f.read().strip().splitlines()[0]
             imap = index_maps[shard]
-            records = avro.read_container_dir(
-                os.path.join(base, COEFFICIENTS)
+            records = _read_coefficients_dir(
+                os.path.join(base, COEFFICIENTS),
+                f"fixed-effect model {name!r}",
             )
             if len(records) != 1:
                 raise ValueError(
@@ -322,7 +350,9 @@ def load_game_model(
             # empty model set, matching the reference's empty-RDD load (and
             # needs no index map for its shard).
             records = (
-                avro.read_container_dir(coef_dir)
+                _read_coefficients_dir(
+                    coef_dir, f"random-effect model {name!r}"
+                )
                 if os.path.isdir(coef_dir) else []
             )
             imap = index_maps[shard] if records else None
@@ -498,8 +528,79 @@ def _ckpt_path(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
-def save_checkpoint(model: GameModel, path: str) -> None:
-    """One-file native GameModel checkpoint (.npz + JSON manifest)."""
+def fsync_dir(path: str) -> None:
+    """Durably commit a rename: fsync the containing directory (the
+    rename itself is atomic; the DIRECTORY entry still needs a sync to
+    survive power loss)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover — exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(
+    path: str, data: bytes | memoryview, *, fault_point: str | None = None
+) -> None:
+    """The one atomic-commit dance every durable artifact goes through:
+    bytes land in a temp sibling that is fsynced, ``os.replace``d over
+    ``path``, and the directory entry is fsynced — a crash at any step
+    leaves either the previous file or the committed new one, never a
+    torn write, and the rename survives power loss. ``fault_point``
+    names an injection point fired in the mid-write crash window (bytes
+    down, rename not yet done) so chaos tests can prove exactly that.
+    Temp debris is removed on any failure."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        if fault_point is not None:
+            from photon_tpu.resilience import faults
+
+            faults.check(fault_point)
+        os.replace(tmp, path)
+    except BaseException:
+        # Never leave tmp debris for a directory listing to confuse
+        # with a committed artifact.
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(os.path.dirname(path) or ".")
+
+
+_META_KEY = "__meta__"
+
+
+def save_checkpoint(
+    model: GameModel,
+    path: str,
+    *,
+    extra_meta: dict | None = None,
+) -> str:
+    """One-file native GameModel checkpoint (.npz + JSON manifest).
+
+    The write is ATOMIC: bytes land in a temp file that is fsynced and
+    ``os.replace``d over ``path``, so a crash (or the injected
+    ``checkpoint.write`` fault) mid-write leaves any previous file at
+    ``path`` untouched and loadable. ``extra_meta`` rides inside the
+    npz under a reserved key — the training checkpointer stores its
+    loop state (config/iteration cursor, static key) there so the
+    artifact is self-contained; read it back with
+    ``load_checkpoint_meta``.
+
+    Returns the sha256 hex digest of the committed bytes, hashed from
+    the in-memory serialization — callers recording content hashes
+    (the training checkpointer's manifest) never re-read the file.
+    """
     path = _ckpt_path(path)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     arrays: dict[str, np.ndarray] = {}
@@ -530,15 +631,60 @@ def save_checkpoint(model: GameModel, path: str) -> None:
             }
         else:
             raise TypeError(f"unknown sub-model type for {name!r}")
+    if _META_KEY in manifest:
+        raise ValueError(
+            f"model coordinate name {_META_KEY!r} collides with the "
+            "checkpoint metadata key")
+    if extra_meta is not None:
+        manifest[_META_KEY] = dict(extra_meta)
     arrays["__manifest__"] = np.frombuffer(
         json.dumps(manifest).encode(), dtype=np.uint8
     )
-    np.savez_compressed(path, **arrays)
+    # Serialize in memory first: np.savez's zip writer seeks back to
+    # patch member headers, so the only way to hash the exact committed
+    # bytes in one pass is to hash the finished buffer.
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    data = buf.getbuffer()  # zero-copy view; getvalue() would double peak RSS
+    digest = hashlib.sha256(data).hexdigest()
+    atomic_write_bytes(path, data, fault_point="checkpoint.write")
+    return digest
 
 
 def load_checkpoint(path: str) -> GameModel:
-    with np.load(_ckpt_path(path)) as z:
+    """Load a native checkpoint; see ``load_checkpoint_meta`` for the
+    embedded loop-state metadata."""
+    return load_checkpoint_meta(path)[0]
+
+
+def load_checkpoint_meta(path: str) -> tuple[GameModel, dict | None]:
+    """Load a native checkpoint plus its ``extra_meta`` (None when the
+    file predates metadata). A truncated / torn npz raises
+    ``CorruptModelError`` naming the file instead of leaking
+    ``zipfile.BadZipFile`` from three layers down."""
+    import zipfile
+
+    path = _ckpt_path(path)
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    try:
+        return _load_checkpoint_impl(path)
+    except (zipfile.BadZipFile, ValueError, KeyError, EOFError,
+            json.JSONDecodeError) as exc:
+        # Deliberately NOT OSError: EACCES / transient filesystem errors
+        # mean the file may be intact — reporting them as corruption
+        # would steer the operator toward deleting a good checkpoint.
+        raise CorruptModelError(
+            f"checkpoint {path}: failed to decode "
+            f"({type(exc).__name__}: {exc}) — the npz is truncated or "
+            "not a photon_tpu checkpoint"
+        ) from exc
+
+
+def _load_checkpoint_impl(path: str) -> tuple[GameModel, dict | None]:
+    with np.load(path) as z:
         manifest = json.loads(bytes(z["__manifest__"]).decode())
+        meta = manifest.pop(_META_KEY, None)
         models: dict[str, object] = {}
         for name, info in manifest.items():
             task = TaskType(info["task"])
@@ -564,4 +710,4 @@ def load_checkpoint(path: str) -> GameModel:
                                if var_key in z else None),
                     entity_keys=tuple(info["entity_keys"]),
                 )
-    return GameModel(models)
+    return GameModel(models), meta

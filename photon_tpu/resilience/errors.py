@@ -1,0 +1,164 @@
+"""Typed failure vocabulary for the resilience layer.
+
+Spark gave the reference fault tolerance for free — RDD lineage replays
+lost partitions, drivers restart mid-job (PAPER.md §0) — so photon-ml
+never needed an error taxonomy. This TPU-native build does: retry
+policies, circuit breakers, and shedding all dispatch on the TYPE of a
+failure, so every failure mode the runtime distinguishes gets its own
+exception class here. This module is a dependency-free leaf (stdlib
+only) so any layer — io, algorithm, serve, cli — can import it without
+cycles.
+
+The split that matters:
+
+- ``TransientError``: expected to succeed on retry (preemption, a
+  flaky compile RPC, a transfer hiccup). What ``resilience.retry``
+  retries — together with REAL backend faults that ``is_transient``
+  recognizes by their gRPC/absl status markers (jaxlib surfaces them
+  as plain ``RuntimeError``, so type alone cannot classify them).
+- ``PoisonError``: deterministic for its input (a malformed request, a
+  bad batch). Retrying would fail forever; it must fail fast and fan
+  out no further than its blast radius (one serve batch, one request).
+
+Everything else (corrupt artifacts, deadline/overload/shutdown serving
+errors, checkpoint mismatches) is neither: not retried, surfaced to
+the caller with enough context to act on.
+"""
+
+from __future__ import annotations
+
+
+class TransientError(RuntimeError):
+    """A failure expected to clear on retry (preemption, flaky RPC)."""
+
+
+class PoisonError(RuntimeError):
+    """A deterministic failure: retrying the same input cannot help."""
+
+
+class InjectedCrash(RuntimeError):
+    """A fault-injection stand-in for a hard process death (the harness
+    raises it where a real crash would kill the process mid-step; tests
+    catch it to assert the on-disk state a real crash would leave)."""
+
+
+class CorruptModelError(RuntimeError):
+    """A model/checkpoint artifact failed to decode.
+
+    Raised by ``io.model_io`` loaders instead of leaking codec
+    tracebacks (``zipfile.BadZipFile``, Avro struct errors); the message
+    names the FILE and what failed so an operator can tell a truncated
+    upload from a wrong path.
+    """
+
+
+class CheckpointError(RuntimeError):
+    """A training checkpoint could not be written or loaded."""
+
+
+class ResumeMismatchError(CheckpointError):
+    """``--resume`` against a checkpoint whose manifest static key does
+    not match the current training configuration — resuming would
+    silently continue a DIFFERENT optimization than the one that wrote
+    the checkpoint."""
+
+
+class NonFiniteUpdateError(RuntimeError):
+    """A coordinate's very first update produced non-finite loss or
+    weights: there is no previous iterate to roll back to, so the run
+    must fail loudly instead of training on garbage."""
+
+
+class TrainingInterrupted(BaseException):
+    """Raised by the CLI's SIGINT/SIGTERM handler to unwind the fit.
+
+    Subclasses ``BaseException`` (like ``KeyboardInterrupt``) so
+    library-level ``except Exception`` recovery paths — retry loops,
+    best-effort warm compiles — never swallow a shutdown request.
+    """
+
+    def __init__(self, signum: int):
+        super().__init__(f"training interrupted by signal {signum}")
+        self.signum = signum
+
+
+class DeadlineExceededError(RuntimeError):
+    """A serve request's deadline expired while it was still queued; it
+    failed fast, before any device work was spent on it."""
+
+
+class OverloadedError(RuntimeError):
+    """The serve queue is past its shed watermark: the request was
+    rejected immediately instead of blocking behind a backlog the
+    server cannot clear in time."""
+
+
+class CircuitOpenError(RuntimeError):
+    """The serve dispatch circuit breaker is open (too many consecutive
+    batch failures): requests fail fast until the breaker is reset."""
+
+
+class ShutdownError(RuntimeError):
+    """The serve queue was closed (or its drain timed out) with this
+    request still pending; it will never be dispatched."""
+
+
+# Real backend failures do not arrive as TransientError — a preempted
+# TPU host, a flaky compile RPC, or a dropped transfer surfaces as a
+# jaxlib RuntimeError (XlaRuntimeError subclasses it) or an OSError
+# carrying a gRPC/absl status string. These markers are the
+# retryable-status vocabulary (gRPC retry guidance: UNAVAILABLE and
+# ABORTED are safe to retry; DEADLINE_EXCEEDED here is the RPC-level
+# status, not a serve-queue request deadline). Deliberately absent:
+# RESOURCE_EXHAUSTED (XLA uses it for HBM OOM, which is deterministic
+# for the program being retried), INVALID_ARGUMENT / INTERNAL (compile
+# bugs), and everything this module types as non-retryable.
+TRANSIENT_ERROR_MARKERS: tuple[str, ...] = (
+    "UNAVAILABLE",
+    "ABORTED",
+    "DEADLINE_EXCEEDED",
+    "Socket closed",
+    "Connection reset",
+    "connection reset",
+    "Broken pipe",
+    "failed to connect",
+    "Failed to connect",
+    "preempted",
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Classify a failure as expected-to-clear-on-retry.
+
+    ``TransientError`` is transient by construction. Anything this
+    module types as deterministic or terminal (poison, corrupt
+    artifacts, checkpoint/serving errors, an injected crash, a signal)
+    is not, whatever its message says. Real backend faults — jaxlib
+    ``RuntimeError``/``OSError``/``ConnectionError`` — are transient
+    when their status string carries a ``TRANSIENT_ERROR_MARKERS``
+    entry; everything else (shape mismatches, real compile errors) is
+    deterministic and must fail on the first attempt.
+    """
+    if isinstance(exc, TransientError):
+        return True
+    if isinstance(
+        exc,
+        (
+            PoisonError,
+            InjectedCrash,
+            CorruptModelError,
+            CheckpointError,
+            NonFiniteUpdateError,
+            DeadlineExceededError,
+            OverloadedError,
+            CircuitOpenError,
+            ShutdownError,
+        ),
+    ):
+        return False
+    if isinstance(exc, ConnectionError):
+        return True
+    if isinstance(exc, (RuntimeError, OSError)):
+        msg = str(exc)
+        return any(marker in msg for marker in TRANSIENT_ERROR_MARKERS)
+    return False

@@ -1,0 +1,220 @@
+"""Retry with exponential backoff + deterministic jitter.
+
+The reference got retries from Spark's task scheduler (a lost executor's
+work is resubmitted transparently); here the compile / transfer /
+dispatch sites call ``call_with_retry`` around their one fallible step.
+The policy is deliberately narrow:
+
+- Only transient failures are retried: ``TransientError`` (and
+  whatever a caller adds to ``retry_on``), plus REAL backend faults
+  the policy's ``classify`` hook recognizes — by default
+  ``errors.is_transient``, which matches the gRPC/absl status markers
+  (UNAVAILABLE, ABORTED, connection resets, preemption) that jaxlib
+  wraps in plain ``RuntimeError``. A ``PoisonError``, a shape
+  mismatch, a real XLA compile error — anything deterministic —
+  propagates on the FIRST attempt; retrying it would just triple the
+  time to the same failure.
+- Attempts are capped (``max_attempts``), backoff is exponential with
+  a cap, and jitter is drawn from an RNG seeded by the call site name —
+  the same run replays the same sleep schedule (chaos tests stay
+  deterministic), while distinct sites still decorrelate.
+- The happy path is free: no locks, no counters, no allocation unless
+  an attempt actually fails. A clean run therefore records ZERO retry
+  stats — which the bench/CI clean-run assertions rely on.
+
+Accounting is two-layer: an always-on module counter dict
+(``retry_stats()``, mirroring ``PIPELINE_STATS``' role for ingest) and,
+when telemetry is enabled, ``retry_*`` obs metrics labeled by site
+(``retry_attempts_total``, ``retry_recovered_total``,
+``retry_exhausted_total``, ``retry_backoff_seconds_total``).
+
+The retry wrapper is HOST-level machinery around already-built
+programs: it never enters a trace, so it adds zero programs and zero
+callbacks to any audited jaxpr — the tier-2 ``resilience-retry``
+contract (declared in ``resilience/__init__.py``) proves that rather
+than promising it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from photon_tpu.resilience import faults
+from photon_tpu.resilience.errors import TransientError, is_transient
+
+logger = logging.getLogger(__name__)
+
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`): `_lock` guards the module stats dict, written from
+# whatever thread retries (compile pool, serve worker, training
+# thread). The happy path never takes the lock — stats move only when
+# an attempt fails.
+CONCURRENCY_AUDIT = dict(
+    name="resilience-retry",
+    locks={
+        "_lock": ("_stats",),
+    },
+    thread_entries=(),
+    jax_dispatch_ok={},
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff with bounded jitter."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5  # delay *= 1 + U(-jitter, +jitter)
+    retry_on: tuple = (TransientError,)
+    # Predicate for failures whose TYPE cannot identify them (jaxlib
+    # wraps backend faults in plain RuntimeError): a failure retries
+    # when it is an instance of ``retry_on`` OR ``classify(exc)`` is
+    # True. None disables message-based classification entirely
+    # (chaos tests that must see ONLY injected faults retried).
+    classify: object = is_transient
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if not (0.0 <= self.jitter < 1.0):
+            raise ValueError("jitter must be in [0, 1)")
+
+    def delay_for(self, attempt: int, rng) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        base = min(
+            self.base_delay_s * self.multiplier ** (attempt - 1),
+            self.max_delay_s,
+        )
+        if self.jitter:
+            base *= 1.0 + self.jitter * float(rng.uniform(-1.0, 1.0))
+        return max(base, 0.0)
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+_lock = threading.Lock()
+_stats = {
+    "retries": 0,  # re-invocations performed
+    "recovered": 0,  # calls that succeeded after >= 1 retry
+    "exhausted": 0,  # calls that failed after the last attempt
+    "backoff_seconds": 0.0,
+}
+
+
+def retry_stats() -> dict:
+    """Snapshot of the module counters (all zero on a clean run)."""
+    with _lock:
+        return dict(_stats)
+
+
+def reset_retry_stats() -> None:
+    with _lock:
+        for k in _stats:
+            _stats[k] = type(_stats[k])()
+
+
+def _record(key: str, value=1) -> None:
+    with _lock:
+        _stats[key] += value
+
+
+def _metric(name: str, site: str, value: float = 1.0) -> None:
+    try:
+        from photon_tpu import obs
+
+        if obs.enabled():
+            obs.REGISTRY.counter(name, site=site).inc(value)
+    except Exception:  # pragma: no cover — telemetry must never abort
+        pass
+
+
+def call_with_retry(
+    fn,
+    *,
+    site: str,
+    policy: RetryPolicy = DEFAULT_POLICY,
+    seed: int | None = None,
+    on_retry=None,
+):
+    """Invoke ``fn()``; retry transient failures per ``policy``.
+
+    ``site`` names the call site for logs/metrics and seeds the jitter
+    stream (override with ``seed``); distinct sites decorrelate, the
+    same site replays the same schedule. Non-retryable exceptions
+    propagate untouched on the first attempt. ``on_retry(attempt, exc)``
+    fires before each backoff sleep — callers hook their own counters
+    (the serve queue's ``dispatch_retries``) without re-implementing
+    the loop.
+    """
+    # The jitter rng is built lazily on the FIRST failure: the happy
+    # path must stay allocation-free (serve batches and fit dispatches
+    # run through here per call). Determinism is unchanged — the stream
+    # is keyed by site/seed alone, not by when it is constructed.
+    rng = None
+    retried = False
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            result = fn()
+        except BaseException as exc:
+            retryable = isinstance(exc, policy.retry_on) or (
+                policy.classify is not None
+                and isinstance(exc, Exception)
+                and policy.classify(exc)
+            )
+            if not retryable:
+                raise
+            _record("retries" if attempt < policy.max_attempts
+                    else "exhausted")
+            _metric("retry_attempts_total", site)
+            if attempt >= policy.max_attempts:
+                _metric("retry_exhausted_total", site)
+                logger.warning(
+                    "%s: transient failure persisted through %d "
+                    "attempt(s): %r", site, attempt, exc)
+                raise
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            if rng is None:
+                rng = np.random.default_rng(
+                    zlib.crc32(site.encode("utf-8"))
+                    if seed is None else seed
+                )
+            delay = policy.delay_for(attempt, rng)
+            _record("backoff_seconds", delay)
+            _metric("retry_backoff_seconds_total", site, delay)
+            logger.info(
+                "%s: transient failure (attempt %d/%d), retrying in "
+                "%.3fs: %r", site, attempt, policy.max_attempts, delay,
+                exc)
+            time.sleep(delay)
+            retried = True
+            continue
+        if retried:
+            _record("recovered")
+            _metric("retry_recovered_total", site)
+        return result
+
+
+def retrying_check(point: str, fn, *, site: str | None = None,
+                   policy: RetryPolicy = DEFAULT_POLICY, on_retry=None):
+    """``call_with_retry`` with the fault-injection hook for ``point``
+    INSIDE the retried thunk — the standard wrapper shape for the
+    compile/transfer/dispatch sites, so an injected transient fault is
+    recovered by the same retry loop a real one would be."""
+
+    def once():
+        faults.check(point)
+        return fn()
+
+    return call_with_retry(
+        once, site=site or point, policy=policy, on_retry=on_retry
+    )

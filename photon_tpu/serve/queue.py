@@ -1,4 +1,4 @@
-"""The serving micro-batch queue: bounded, lingering, draining.
+"""The serving micro-batch queue: bounded, lingering, draining, degrading.
 
 One worker thread owns all device dispatch; producers (request handler
 threads, the synchronous driver) hand ``(features, entity_ids)`` pairs
@@ -10,11 +10,50 @@ the OLDEST queued request has lingered ``max_linger_s`` — small linger
 bounded (``max_queue``): producers block for space, so an overloaded
 server applies backpressure instead of growing an unbounded heap.
 
+Degraded mode (the resilience layer). Deadlines, shedding, and the
+circuit breaker default OFF, so those stay off the clean path entirely;
+dispatch retry is the one knob that defaults ON
+(``dispatch_retry=_DISPATCH_RETRY``: 3 attempts, 5 ms base backoff) —
+a transient device fault is retried in place before any error fans
+out, and a retry's backoff does stack onto that batch's latency. Pass
+``dispatch_retry=None`` for the old fail-on-first-attempt semantics.
+
+- **Deadlines**: a request submitted with ``deadline_s`` (or a queue
+  ``default_deadline_s``) that is still queued when it expires FAILS
+  FAST with ``DeadlineExceededError`` — before any padding or device
+  work is spent on it. A late response is worth nothing; the capacity
+  goes to requests that can still make their deadline. Deadlines also
+  CUT THE LINGER SHORT: a batch whose earliest deadline would lapse
+  mid-linger flushes early enough to dispatch in time, so a deadline
+  tighter than ``max_linger_s`` is served, not expired on an idle
+  device.
+- **Shedding**: with ``shed_watermark`` set, a submit finding that many
+  requests already queued is rejected immediately with
+  ``OverloadedError`` (typed, countable) instead of blocking — the
+  overloaded server stays responsive about being overloaded.
+- **Circuit breaker**: ``breaker_threshold`` consecutive dispatch
+  failures open the breaker — the pending queue drains with
+  ``CircuitOpenError``, new submits fail fast, and ``reset_breaker()``
+  re-arms after the operator (or a supervisor) intervenes. A wedged
+  model never spins the worker through an unbounded failure loop.
+- **Dispatch retry**: transient dispatch failures (``TransientError``,
+  e.g. the injected ``serve.dispatch`` fault) are retried with backoff
+  before any error fans out; deterministic failures (``PoisonError``, a
+  malformed request) fan out to exactly their batch on the first
+  attempt.
+- **health()**: one locked snapshot — queue depth, shed / deadline /
+  error / retry / breaker counters, coefficient-table generation — the
+  CLI and bench surface it.
+
 Shutdown drains: ``close()`` wakes the worker, which keeps flushing
 until the queue is empty, then exits; every in-flight future resolves.
-A submit after close fails fast. Exceptions from a batch dispatch fan
-out to THAT batch's futures (each waiter sees the error; the worker
-keeps serving subsequent batches).
+``close(timeout=...)`` bounds the drain: if the worker is wedged in a
+dispatch past the timeout, every still-queued future fails with
+``ShutdownError`` and close returns False (the worker thread is a
+daemon, so a wedged executable cannot hang process exit). A submit
+after close fails fast. Exceptions from a batch dispatch fan out to
+THAT batch's futures (each waiter sees the error; the worker keeps
+serving subsequent batches).
 """
 
 from __future__ import annotations
@@ -26,18 +65,30 @@ import time
 
 import numpy as np
 
+from photon_tpu.resilience import retry as _retry
+from photon_tpu.resilience.errors import (
+    CircuitOpenError,
+    DeadlineExceededError,
+    OverloadedError,
+    ShutdownError,
+)
+
 logger = logging.getLogger(__name__)
 
 # Host-concurrency contract (audited by `python -m photon_tpu.analysis
 # --concurrency`). The threading model is single-consumer: ONE worker
 # thread pops, pads, dispatches, and scatters; any number of producer
 # threads push. `_cond` (a Condition, which is also the mutex) guards
-# the pending deque, the closed flag, and the stats dict; the worker
-# snapshots a batch UNDER the lock and dispatches OUTSIDE it, so
-# producers never queue behind an XLA execution. Futures are created
-# here (not executor-submitted) and every one is resolved — by the
-# batch's results, by the batch's exception, or by close()'s
-# drain — so no waiter can hang on a dropped future.
+# the pending deque, the closed flag, the stats dict, and the degraded-
+# mode state (breaker open/failure-streak, the deadline-scan latch);
+# the worker snapshots a batch UNDER the lock and dispatches OUTSIDE
+# it, so producers never queue behind an XLA execution — and every
+# future resolution (results, errors, deadline expiry, breaker drain,
+# shutdown strand) also runs OUTSIDE the lock, because resolution runs
+# user callbacks. Futures are created here (not executor-submitted)
+# and every one is resolved — by the batch's results, by the batch's
+# exception, by deadline expiry, by the breaker drain, or by close()'s
+# drain/timeout — so no waiter can hang on a dropped future.
 CONCURRENCY_AUDIT = dict(
     name="serve-queue",
     locks={
@@ -45,6 +96,10 @@ CONCURRENCY_AUDIT = dict(
             "MicroBatchQueue._pending",
             "MicroBatchQueue._closed",
             "MicroBatchQueue._stats",
+            "MicroBatchQueue._breaker_open",
+            "MicroBatchQueue._consecutive_failures",
+            "MicroBatchQueue._has_deadlines",
+            "MicroBatchQueue._close_stranded",
         ),
         "_Future._lock": (
             "_Future._callbacks",
@@ -58,15 +113,16 @@ CONCURRENCY_AUDIT = dict(
         "MicroBatchQueue._dispatch",
     ),
     jax_dispatch_ok={
-        "_worker": "the worker loop itself only pops/waits; all device "
-        "work is in _dispatch (declared below)",
+        "_worker": "the worker loop itself only pops/waits/expires; "
+        "all device work is in _dispatch (declared below)",
         "_dispatch": "dispatches PRE-COMPILED AOT executables only "
         "(ScorePrograms.score_padded) — no tracing, no compilation can "
         "occur on this thread (the ladder is compiled at construction "
         "on the caller's thread and score_padded raises on an "
         "un-compiled rung); the single worker thread serializes every "
-        "dispatch, and the np.asarray fetch is the request path's one "
-        "intended host sync",
+        "dispatch (the transient-retry loop re-enters the same "
+        "executables with the same operands), and the np.asarray fetch "
+        "is the request path's one intended host sync",
     },
 )
 
@@ -76,13 +132,20 @@ class QueueClosed(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("features", "entity_ids", "future", "enqueued_at")
+    __slots__ = (
+        "features", "entity_ids", "future", "enqueued_at", "deadline"
+    )
 
-    def __init__(self, features: dict, entity_ids: dict):
+    def __init__(self, features: dict, entity_ids: dict,
+                 deadline_s: float | None = None):
         self.features = features
         self.entity_ids = entity_ids
         self.future = _Future()
         self.enqueued_at = time.perf_counter()
+        self.deadline = (
+            None if deadline_s is None
+            else self.enqueued_at + float(deadline_s)
+        )
 
 
 class _Future:
@@ -156,6 +219,23 @@ class _Future:
         return self._exc
 
 
+# Dispatch retry default: two quick re-attempts. A transient dispatch
+# failure clears in milliseconds or not at all; long backoff would just
+# stack linger on every queued request behind the batch.
+_DISPATCH_RETRY = _retry.RetryPolicy(
+    max_attempts=3, base_delay_s=0.005, max_delay_s=0.1
+)
+
+# How far BEFORE the earliest pending deadline the linger wait flushes:
+# waking exactly at the deadline would expire the request in the same
+# scan that was meant to save it, and Condition.wait oversleeps by
+# scheduler jitter (tens of ms observed on the loaded 2-core CI box).
+# Erring early is safe — the batch just dispatches a little less full —
+# erring late expires a servable request, so the slack is generous. A
+# request with less budget left than this was unservable anyway.
+_DEADLINE_FLUSH_SLACK_S = 25e-3
+
+
 class MicroBatchQueue:
     """Bounded micro-batching front of a ``ScorePrograms`` ladder."""
 
@@ -166,6 +246,11 @@ class MicroBatchQueue:
         max_batch: int | None = None,
         max_linger_s: float = 0.002,
         max_queue: int = 4096,
+        default_deadline_s: float | None = None,
+        shed_watermark: int | None = None,
+        breaker_threshold: int | None = None,
+        dispatch_retry: "_retry.RetryPolicy | None" = _DISPATCH_RETRY,
+        close_timeout_s: float | None = None,
     ):
         self.programs = programs
         top = programs.ladder.max_batch
@@ -176,9 +261,29 @@ class MicroBatchQueue:
             raise ValueError("max_batch must be >= 1")
         self.max_linger_s = float(max_linger_s)
         self.max_queue = max(int(max_queue), self.max_batch)
+        self.default_deadline_s = default_deadline_s
+        self.shed_watermark = (
+            None if shed_watermark is None
+            else max(int(shed_watermark), 1)
+        )
+        self.breaker_threshold = (
+            None if breaker_threshold is None
+            else max(int(breaker_threshold), 1)
+        )
+        self.dispatch_retry = dispatch_retry
+        # Bounds the context-manager exit (``with`` blocks call close()
+        # with no argument, which would otherwise join a wedged
+        # dispatch forever).
+        self.close_timeout_s = close_timeout_s
         self._cond = threading.Condition()
         self._pending: collections.deque[_Request] = collections.deque()
         self._closed = False
+        self._close_stranded = False
+        self._breaker_open = False
+        self._consecutive_failures = 0
+        # Latched on the first deadline-bearing submit so the worker's
+        # expiry scan stays off the clean path entirely.
+        self._has_deadlines = default_deadline_s is not None
         self._stats = {
             "requests": 0,
             "batches": 0,
@@ -187,49 +292,133 @@ class MicroBatchQueue:
             "entity_lookups": 0,
             "rejected": 0,
             "dispatch_errors": 0,
+            "dispatch_retries": 0,
+            "deadline_expired": 0,
+            "shed": 0,
+            "breaker_trips": 0,
+            "breaker_rejected": 0,
+            "shutdown_stranded": 0,
         }
         self._thread = threading.Thread(
-            target=self._worker, name="photon-serve-worker"
+            target=self._worker, name="photon-serve-worker",
+            # Daemon: a dispatch wedged in native code past a
+            # close(timeout=...) must not be able to hang process exit.
+            daemon=True,
         )
         self._thread.start()
 
     # -- producer side ----------------------------------------------------
 
-    def submit(self, features: dict, entity_ids: dict | None = None):
+    def submit(self, features: dict, entity_ids: dict | None = None,
+               *, deadline_s: float | None = None):
         """Queue one request; returns its Future.
 
         ``features`` maps feature shard id -> the spec's request leaf
         (dense: [d] vector; sparse: ([k] indices, [k] values));
-        ``entity_ids`` maps random-effect type -> entity key. Blocks
-        while the queue is at ``max_queue`` (backpressure).
+        ``entity_ids`` maps random-effect type -> entity key;
+        ``deadline_s`` (or the queue's ``default_deadline_s``) bounds
+        how long the request may wait before it fails fast. Blocks
+        while the queue is at ``max_queue`` (backpressure) unless a
+        ``shed_watermark`` rejects first; raises typed errors instead
+        of queueing when the queue is closed, shedding, or the
+        dispatch circuit breaker is open.
         """
-        req = _Request(features, dict(entity_ids or {}))
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        req = _Request(features, dict(entity_ids or {}), deadline_s)
         with self._cond:
-            while (
-                len(self._pending) >= self.max_queue and not self._closed
-            ):
+            while True:
+                if self._closed:
+                    self._stats["rejected"] += 1
+                    raise QueueClosed("serve queue is closed")
+                if self._breaker_open:
+                    self._stats["breaker_rejected"] += 1
+                    raise CircuitOpenError(
+                        "serve dispatch circuit breaker is open "
+                        f"(tripped after {self.breaker_threshold} "
+                        "consecutive batch failures); reset_breaker() "
+                        "to resume")
+                if (
+                    self.shed_watermark is not None
+                    and len(self._pending) >= self.shed_watermark
+                ):
+                    self._stats["shed"] += 1
+                    raise OverloadedError(
+                        f"serve queue depth {len(self._pending)} is at "
+                        f"the shed watermark {self.shed_watermark}; "
+                        "request rejected instead of queued")
+                if len(self._pending) < self.max_queue:
+                    break
                 self._cond.wait()
-            if self._closed:
-                self._stats["rejected"] += 1
-                raise QueueClosed("serve queue is closed")
+            if req.deadline is not None:
+                self._has_deadlines = True
             self._pending.append(req)
             self._stats["requests"] += 1
             self._cond.notify_all()
         return req.future
 
-    def close(self) -> None:
+    def close(self, timeout: float | None = None) -> bool:
         """Stop accepting requests, drain everything queued, join the
-        worker. Idempotent."""
+        worker. Idempotent.
+
+        ``timeout`` bounds the drain-and-join: a dispatch wedged in
+        native code can otherwise hang shutdown forever. On timeout,
+        every request still QUEUED (never handed to the worker) fails
+        with ``ShutdownError`` and close returns False — the in-flight
+        batch's futures stay owned by the (daemon) worker, which will
+        resolve them if the dispatch ever returns. Returns True when
+        the drain completed. Once a bounded close has stranded the
+        queue, a later ``close()`` with no timeout polls the wedged
+        worker instead of joining it forever (the caller already opted
+        into bounded shutdown).
+        """
         with self._cond:
             self._closed = True
+            already_stranded = self._close_stranded
             self._cond.notify_all()
-        self._thread.join()
+        if already_stranded and timeout is None:
+            # A prior bounded close already timed out and failed every
+            # queued request; an unbounded join now (e.g. the ``with``
+            # block exiting after a failed close(timeout=...)) would
+            # reintroduce exactly the hang that close was bounded to
+            # avoid. Poll the wedged worker instead of waiting on it.
+            timeout = 0.0
+        self._thread.join(timeout)
+        if not self._thread.is_alive():
+            return True
+        if already_stranded:
+            return False
+        with self._cond:
+            self._close_stranded = True
+            stranded = list(self._pending)
+            self._pending.clear()
+            self._stats["shutdown_stranded"] += len(stranded)
+            self._cond.notify_all()
+        logger.error(
+            "serve queue close(): drain did not finish in %.3fs; "
+            "failing %d still-queued request(s) with ShutdownError",
+            timeout, len(stranded))
+        exc = ShutdownError(
+            f"serve queue drain exceeded its {timeout}s close timeout; "
+            "request abandoned before dispatch")
+        for r in stranded:
+            r.future.set_exception(exc)
+        return False
+
+    def reset_breaker(self) -> None:
+        """Re-arm a tripped dispatch circuit breaker (operator action
+        after the underlying failure — bad model reload, device loss —
+        is addressed)."""
+        with self._cond:
+            self._breaker_open = False
+            self._consecutive_failures = 0
+            self._cond.notify_all()
 
     def __enter__(self) -> "MicroBatchQueue":
         return self
 
     def __exit__(self, *exc) -> None:
-        self.close()
+        self.close(self.close_timeout_s)
 
     def stats(self) -> dict:
         """Snapshot of the queue counters (+ derived fill/cold rates)."""
@@ -255,58 +444,150 @@ class MicroBatchQueue:
         )
         return snap
 
+    def health(self) -> dict:
+        """One consistent degraded-mode snapshot: queue depth, breaker
+        state, shed/deadline/error/retry counters, and the coefficient
+        tables' reload generation — what a load balancer's health probe
+        (and ``cli.serve`` / ``bench.py``) reads."""
+        with self._cond:
+            snap = {
+                "queue_depth": len(self._pending),
+                "closed": self._closed,
+                "breaker_open": self._breaker_open,
+                "consecutive_failures": self._consecutive_failures,
+                "requests": self._stats["requests"],
+                "shed": self._stats["shed"],
+                "deadline_expired": self._stats["deadline_expired"],
+                "dispatch_errors": self._stats["dispatch_errors"],
+                "dispatch_retries": self._stats["dispatch_retries"],
+                "breaker_trips": self._stats["breaker_trips"],
+                "breaker_rejected": self._stats["breaker_rejected"],
+                "shutdown_stranded": self._stats["shutdown_stranded"],
+            }
+        snap["max_queue"] = self.max_queue
+        snap["shed_watermark"] = self.shed_watermark
+        snap["breaker_threshold"] = self.breaker_threshold
+        snap["default_deadline_s"] = self.default_deadline_s
+        snap["table_generation"] = getattr(
+            self.programs.tables, "generation", 0
+        )
+        return snap
+
     # -- worker side ------------------------------------------------------
 
-    def _take_batch(self) -> list[_Request] | None:
-        """Block for the next batch per the flush policy; None = exit.
+    def _expire_locked(self) -> list[_Request]:
+        """Pull every pending request whose deadline has passed (caller
+        holds ``_cond``; the returned requests are resolved OUTSIDE the
+        lock). Skipped entirely until a deadline-bearing request has
+        ever been submitted."""
+        if not self._has_deadlines or not self._pending:
+            return []
+        now = time.perf_counter()
+        expired = [
+            r for r in self._pending
+            if r.deadline is not None and now >= r.deadline
+        ]
+        if expired:
+            self._pending = collections.deque(  # photon: ignore[unlocked-shared-write] -- _expire_locked is called only from _take_batch's `with self._cond` scope (the _locked suffix is the calling convention)
+                r for r in self._pending
+                if r.deadline is None or now < r.deadline
+            )
+            self._stats["deadline_expired"] += len(expired)  # photon: ignore[unlocked-shared-write] -- same: caller holds _cond (see _expire_locked docstring)
+            self._cond.notify_all()  # space freed: wake producers
+        return expired
 
-        Runs on the worker thread. Returns once ``max_batch`` requests
-        are pending, the oldest pending request has lingered
-        ``max_linger_s``, or the queue closed (flush what remains;
-        return None only when closed AND empty).
+    def _take_batch(self) -> tuple[list[_Request] | None, list[_Request]]:
+        """Block for the next batch per the flush policy.
+
+        Runs on the worker thread. Returns ``(batch, expired)``:
+        ``batch`` is None when the queue closed AND drained (exit),
+        possibly-empty when only expirations happened this round;
+        ``expired`` requests failed their deadline while queued and
+        must be resolved by the caller (outside the lock), BEFORE any
+        device work is spent on the batch.
         """
         with self._cond:
             while True:
+                expired = self._expire_locked()
                 if self._pending:
-                    deadline = (
+                    linger_end = (
                         self._pending[0].enqueued_at + self.max_linger_s
                     )
                     while (
                         len(self._pending) < self.max_batch
                         and not self._closed
                     ):
-                        remaining = deadline - time.perf_counter()
+                        # The linger is cut short by request deadlines:
+                        # a deadline that would lapse mid-linger flushes
+                        # the batch _DEADLINE_FLUSH_SLACK_S early so the
+                        # request DISPATCHES in time instead of expiring
+                        # on an idle device (linger 200ms + deadline
+                        # 100ms must serve, not fail 100%).
+                        flush_at = linger_end
+                        if self._has_deadlines:
+                            earliest = min(
+                                (r.deadline for r in self._pending
+                                 if r.deadline is not None),
+                                default=None,
+                            )
+                            if earliest is not None:
+                                flush_at = min(
+                                    flush_at,
+                                    earliest - _DEADLINE_FLUSH_SLACK_S,
+                                )
+                        remaining = flush_at - time.perf_counter()
                         if remaining <= 0:
                             break
                         self._cond.wait(timeout=remaining)
+                    # Deadlines may have lapsed during the linger wait;
+                    # a request must never reach dispatch already dead.
+                    expired.extend(self._expire_locked())
                     batch = [
                         self._pending.popleft()
                         for _ in range(
                             min(len(self._pending), self.max_batch)
                         )
                     ]
-                    self._stats["batches"] += 1
-                    self._stats["batched_requests"] += len(batch)
+                    if batch:
+                        self._stats["batches"] += 1
+                        self._stats["batched_requests"] += len(batch)
                     self._cond.notify_all()  # space freed: wake producers
-                    return batch
-                if self._closed:
-                    return None
+                    return batch, expired
+                if self._closed or expired:
+                    return (None if self._closed else []), expired
                 self._cond.wait()
 
     def _worker(self) -> None:
         while True:
-            batch = self._take_batch()
+            batch, expired = self._take_batch()
+            if expired:
+                exc = DeadlineExceededError(
+                    "request deadline expired while queued; failed "
+                    "fast before dispatch")
+                for r in expired:
+                    r.future.set_exception(exc)
+                from photon_tpu import obs
+
+                if obs.enabled():
+                    obs.REGISTRY.counter(
+                        "serve_deadline_expired_total"
+                    ).inc(len(expired))
             if batch is None:
                 return
-            self._dispatch(batch)
+            if batch:
+                self._dispatch(batch)
 
     def _dispatch(self, batch: list[_Request]) -> None:
         """Pad, score, scatter — outside the lock (producers keep
-        queuing while XLA runs). Runs on the worker thread only."""
+        queuing while XLA runs). Runs on the worker thread only.
+        Transient failures retry with backoff (``dispatch_retry``);
+        anything else fans out to THIS batch's futures and feeds the
+        circuit breaker's consecutive-failure count."""
         from photon_tpu import obs
 
         t0 = time.perf_counter()
-        try:
+
+        def attempt():
             feats, codes, _rung = self.programs.pack_requests(
                 [(r.features, r.entity_ids) for r in batch]
             )
@@ -314,18 +595,66 @@ class MicroBatchQueue:
                 int(np.sum(vec[: len(batch)] < 0))
                 for vec in codes.values()
             )
-            lookups = len(codes) * len(batch)
             with obs.span("serve/batch"):
                 scores = self.programs.score_padded(
                     feats, codes, len(batch)
                 )
+            return cold, len(codes) * len(batch), scores
+
+        def on_retry(attempt_no, exc):
+            with self._cond:
+                self._stats["dispatch_retries"] += 1
+            if obs.enabled():
+                obs.REGISTRY.counter("serve_dispatch_retries_total").inc()
+
+        try:
+            if self.dispatch_retry is not None:
+                cold, lookups, scores = _retry.retrying_check(
+                    "serve.dispatch", attempt,
+                    site="serve.dispatch",
+                    policy=self.dispatch_retry,
+                    on_retry=on_retry,
+                )
+            else:
+                from photon_tpu.resilience import faults
+
+                faults.check("serve.dispatch")
+                cold, lookups, scores = attempt()
         except Exception as exc:  # noqa: BLE001 — fan out to the waiters
+            drained: list[_Request] = []
             with self._cond:
                 self._stats["dispatch_errors"] += 1
+                self._consecutive_failures += 1
+                tripped = (
+                    self.breaker_threshold is not None
+                    and not self._breaker_open
+                    and self._consecutive_failures
+                    >= self.breaker_threshold
+                )
+                if tripped:
+                    self._breaker_open = True
+                    self._stats["breaker_trips"] += 1
+                    drained = list(self._pending)
+                    self._pending.clear()
+                    self._cond.notify_all()
             for r in batch:
                 r.future.set_exception(exc)
+            if tripped:
+                logger.error(
+                    "serve dispatch circuit breaker OPEN after %d "
+                    "consecutive batch failure(s) (last: %r); drained "
+                    "%d queued request(s)",
+                    self._consecutive_failures, exc, len(drained))
+                drain_exc = CircuitOpenError(
+                    "serve dispatch circuit breaker opened while this "
+                    f"request was queued (last failure: {exc!r})")
+                for r in drained:
+                    r.future.set_exception(drain_exc)
+                if obs.enabled():
+                    obs.REGISTRY.counter("serve_breaker_trips_total").inc()
             return
         with self._cond:
+            self._consecutive_failures = 0
             self._stats["cold_lookups"] += cold
             self._stats["entity_lookups"] += lookups
         if obs.enabled():

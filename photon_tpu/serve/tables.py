@@ -116,6 +116,11 @@ class CoefficientTables:
     fixed: dict[str, FixedTable]
     random: dict[str, RandomTable]
     task: TaskType
+    # Monotone model-reload counter: 0 at construction, +1 per reload
+    # (in-place swap or rebuild). Surfaced by the serve queue's
+    # ``health()`` so an operator can confirm which coefficient
+    # generation is live without comparing arrays.
+    generation: int = 0
 
     @property
     def coordinate_order(self) -> tuple[str, ...]:
@@ -243,6 +248,7 @@ class CoefficientTables:
         rebuild its score programs if shapes changed.
         """
         new = CoefficientTables.from_game_model(model)
+        self.generation += 1
         if not self._values_only_delta(new):
             self.fixed = new.fixed
             self.random = new.random

@@ -80,11 +80,20 @@ def aot_compile(lowered):
     resulting executable goes unused — a stale shape prediction — the
     fallback jit path's compile becomes a cache hit instead of a second
     full compile. Counted in ``cache_stats()``.
+
+    A RETRIED site (resilience layer): a transient compile failure — a
+    flaky compiler RPC on tunneled backends, the injected
+    ``compile.aot`` fault — re-runs ``lowered.compile()`` with backoff;
+    deterministic compile errors propagate on the first attempt.
     """
     import time
 
+    from photon_tpu.resilience import retry
+
     t0 = time.perf_counter()
-    compiled = lowered.compile()
+    compiled = retry.retrying_check(
+        "compile.aot", lowered.compile, site="compile_cache.aot_compile"
+    )
     seconds = time.perf_counter() - t0
     with _lock:
         _stats["aot_compiles"] += 1

@@ -357,6 +357,39 @@ def test_fused_cold_jit_window_is_not_attributed(telemetry, monkeypatch):
     assert [s.attrs["fit_window_pure"] for s in fused] == [False, True]
 
 
+def test_fused_retried_dispatch_window_is_not_attributed(
+    telemetry, monkeypatch
+):
+    """A retried fit dispatch puts a failed attempt + the backoff sleep
+    inside the timed window — even a warm re-entry must keep
+    seconds=None (regression: attempt 2 re-derived fit_window_pure from
+    _jit_seen, which attempt 1 had already populated, and attributed a
+    window that contained the retry)."""
+    import jax
+
+    from photon_tpu.analysis import program
+    from photon_tpu.resilience import (
+        FaultPlan,
+        faults,
+        reset_retry_stats,
+    )
+
+    monkeypatch.setenv("PHOTON_TPU_SERIAL_INGEST", "1")
+    try:
+        with jax.experimental.disable_x64():
+            est, data = program._tiny_glmix()
+            est.prepare(data)
+            est.fit(data)  # warm the jit path: statics enter _jit_seen
+            plan = FaultPlan([dict(point="fit.dispatch", nth=1)])
+            with faults.injected(plan):
+                retried = est.fit(data)[0]
+    finally:
+        reset_retry_stats()
+    assert all(rec.seconds is None for rec in retried.descent.history)
+    fused = [s for s in obs.TRACER.completed() if s.name == "fused_fit"]
+    assert fused[-1].attrs["fit_window_pure"] is False
+
+
 def test_fused_fit_telemetry_off_keeps_seconds_none(telemetry_off):
     import jax
 

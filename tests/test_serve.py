@@ -649,3 +649,317 @@ class TestServeCli:
         assert out["programs_compiled"] == 3
         assert out["errors"] == 0
         assert (tmp_path / "serve.json").is_file()
+
+
+class TestDegradedServing:
+    """The resilience layer's serving half (RESILIENCE.md): deadlines,
+    shedding, the dispatch circuit breaker, bounded shutdown, retry,
+    and the health snapshot. Every knob defaults OFF — the clean-path
+    tests above run the queue exactly as before."""
+
+    def _programs(self, rng, rungs=(1, 4)):
+        tables = CoefficientTables.from_game_model(_glmix_model(rng))
+        return tables, ScorePrograms(tables, ladder=ShapeLadder(rungs))
+
+    def _request(self, rng, user="1"):
+        return (
+            {
+                "features": rng.normal(size=D).astype(np.float32),
+                "userShard": rng.normal(size=DU).astype(np.float32),
+            },
+            {"userId": user},
+        )
+
+    def test_expired_deadline_fails_fast_before_dispatch(self, rng):
+        from photon_tpu.resilience import DeadlineExceededError
+
+        _, programs = self._programs(rng)
+        with MicroBatchQueue(
+            programs, max_batch=4, max_linger_s=0.2
+        ) as q:
+            dead = q.submit(*self._request(rng), deadline_s=0.0)
+            exc = dead.exception(timeout=10)
+            assert isinstance(exc, DeadlineExceededError)
+            # the queue keeps serving deadline-free requests
+            ok = q.submit(*self._request(rng))
+            assert np.isfinite(ok.result(timeout=10))
+        stats = q.stats()
+        assert stats["deadline_expired"] == 1
+        # the expired request never reached a batch
+        assert stats["batched_requests"] == 1
+
+    def test_default_deadline_applies(self, rng):
+        from photon_tpu.resilience import DeadlineExceededError
+
+        _, programs = self._programs(rng)
+        with MicroBatchQueue(
+            programs, max_batch=4, max_linger_s=0.2,
+            default_deadline_s=0.0,
+        ) as q:
+            fut = q.submit(*self._request(rng))
+            assert isinstance(
+                fut.exception(timeout=10), DeadlineExceededError
+            )
+
+    def test_deadline_tighter_than_linger_is_served(self, rng):
+        """A deadline shorter than ``max_linger_s`` must cut the linger
+        short and DISPATCH the request in time — not let it expire on an
+        idle device while the worker waits out the full linger."""
+        _, programs = self._programs(rng)
+        with MicroBatchQueue(
+            programs, max_batch=4, max_linger_s=5.0,
+        ) as q:
+            t0 = time.perf_counter()
+            fut = q.submit(*self._request(rng), deadline_s=0.25)
+            # served (not DeadlineExceededError), and well before the
+            # 5s linger would have flushed it
+            assert np.isfinite(fut.result(timeout=10))
+            assert time.perf_counter() - t0 < 2.0
+        stats = q.stats()
+        assert stats["deadline_expired"] == 0
+        assert stats["batched_requests"] == 1
+
+    def test_shed_beyond_watermark(self, rng):
+        from photon_tpu.resilience import OverloadedError
+
+        _, programs = self._programs(rng)
+        # A wedge dispatch holds the worker so the queue depth is
+        # controlled deterministically.
+        release = threading.Event()
+
+        class Slow:
+            ladder = programs.ladder
+            tables = programs.tables
+
+            def pack_requests(self, reqs):
+                release.wait(30)
+                return programs.pack_requests(reqs)
+
+            def score_padded(self, *a):
+                return programs.score_padded(*a)
+
+        q = MicroBatchQueue(
+            Slow(), max_batch=1, max_linger_s=0.0, shed_watermark=2
+        )
+        try:
+            first = q.submit(*self._request(rng))  # taken by worker
+            # wait until the worker holds it (pending drained)
+            deadline = time.time() + 10
+            while q.stats()["queued_now"] and time.time() < deadline:
+                time.sleep(0.01)
+            queued = [q.submit(*self._request(rng)) for _ in range(2)]
+            with pytest.raises(OverloadedError):
+                q.submit(*self._request(rng))
+            assert q.stats()["shed"] == 1
+            release.set()
+            assert np.isfinite(first.result(timeout=10))
+            for f in queued:
+                assert np.isfinite(f.result(timeout=10))
+        finally:
+            release.set()
+            q.close()
+
+    def test_transient_dispatch_fault_is_retried(self, rng):
+        from photon_tpu.resilience import FaultPlan, faults
+
+        _, programs = self._programs(rng)
+        plan = FaultPlan(
+            [dict(point="serve.dispatch", nth=1, error="transient")]
+        )
+        with faults.injected(plan):
+            with MicroBatchQueue(programs, max_linger_s=0.001) as q:
+                fut = q.submit(*self._request(rng))
+                assert np.isfinite(fut.result(timeout=10))
+        stats = q.stats()
+        assert stats["dispatch_retries"] == 1
+        assert stats["dispatch_errors"] == 0
+
+    def test_poison_fans_out_to_its_batch_only(self, rng):
+        from photon_tpu.resilience import FaultPlan, PoisonError, faults
+
+        _, programs = self._programs(rng)
+        plan = FaultPlan(
+            [dict(point="serve.dispatch", nth=1, error="poison")]
+        )
+        with faults.injected(plan):
+            with MicroBatchQueue(
+                programs, max_batch=4, max_linger_s=0.01
+            ) as q:
+                bad = [q.submit(*self._request(rng)) for _ in range(4)]
+                for f in bad:
+                    f.exception(timeout=10)
+                good = [q.submit(*self._request(rng)) for _ in range(4)]
+                for f in good:
+                    assert np.isfinite(f.result(timeout=10))
+        assert all(
+            isinstance(f.exception(), PoisonError) for f in bad
+        )
+        stats = q.stats()
+        assert stats["dispatch_errors"] == 1  # one poisoned batch
+        assert stats["dispatch_retries"] == 0  # poison is never retried
+
+    def test_breaker_trips_drains_and_resets(self, rng):
+        from photon_tpu.resilience import (
+            CircuitOpenError,
+            FaultPlan,
+            PoisonError,
+            faults,
+        )
+
+        _, programs = self._programs(rng)
+        plan = FaultPlan(
+            [dict(point="serve.dispatch", probability=1.0,
+                  error="poison")],
+            seed=1,
+        )
+        q = MicroBatchQueue(
+            programs, max_batch=1, max_linger_s=0.0,
+            breaker_threshold=2,
+        )
+        try:
+            with faults.injected(plan):
+                futs = [q.submit(*self._request(rng)) for _ in range(2)]
+                for f in futs:
+                    assert isinstance(
+                        f.exception(timeout=10), PoisonError
+                    )
+                with pytest.raises(CircuitOpenError):
+                    q.submit(*self._request(rng))
+            health = q.health()
+            assert health["breaker_open"] is True
+            assert health["breaker_trips"] == 1
+            assert health["breaker_rejected"] == 1
+            # operator intervention: reset re-arms dispatch
+            q.reset_breaker()
+            fut = q.submit(*self._request(rng))
+            assert np.isfinite(fut.result(timeout=10))
+            assert q.health()["breaker_open"] is False
+        finally:
+            q.close()
+
+    def test_close_timeout_strands_queued_requests(self, rng):
+        from photon_tpu.resilience import ShutdownError
+
+        _, programs = self._programs(rng)
+        release = threading.Event()
+
+        class Wedged:
+            ladder = programs.ladder
+            tables = programs.tables
+
+            def pack_requests(self, reqs):
+                release.wait(60)
+                raise RuntimeError("wedged dispatch released")
+
+            def score_padded(self, *a):  # pragma: no cover
+                raise AssertionError
+
+        q = MicroBatchQueue(
+            Wedged(), max_batch=1, max_linger_s=0.0,
+            dispatch_retry=None,
+        )
+        try:
+            in_flight = q.submit(*self._request(rng))
+            deadline = time.time() + 10
+            while q.stats()["queued_now"] and time.time() < deadline:
+                time.sleep(0.01)
+            queued = q.submit(*self._request(rng))
+            t0 = time.time()
+            assert q.close(timeout=0.3) is False
+            assert time.time() - t0 < 5
+            # the still-queued request failed with the typed shutdown
+            # error; the in-flight one stays owned by the worker
+            assert isinstance(
+                queued.exception(timeout=1), ShutdownError
+            )
+            assert q.stats()["shutdown_stranded"] == 1
+            assert not in_flight.done()
+        finally:
+            release.set()
+
+    def test_wedged_dispatch_cannot_hang_context_exit(self, rng):
+        """The ``with`` block exits through close(close_timeout_s) —
+        without the ctor knob the bounded-shutdown machinery is
+        unreachable from the context-manager path — and a later
+        close() with NO timeout polls the already-stranded worker
+        instead of joining it forever."""
+        from photon_tpu.resilience import ShutdownError
+
+        _, programs = self._programs(rng)
+        release = threading.Event()
+
+        class Wedged:
+            ladder = programs.ladder
+            tables = programs.tables
+
+            def pack_requests(self, reqs):
+                release.wait(60)
+                raise RuntimeError("wedged dispatch released")
+
+            def score_padded(self, *a):  # pragma: no cover
+                raise AssertionError
+
+        try:
+            t0 = time.time()
+            with MicroBatchQueue(
+                Wedged(), max_batch=1, max_linger_s=0.0,
+                dispatch_retry=None, close_timeout_s=0.3,
+            ) as q:
+                q.submit(*self._request(rng))
+                deadline = time.time() + 10
+                while q.stats()["queued_now"] and time.time() < deadline:
+                    time.sleep(0.01)
+                queued = q.submit(*self._request(rng))
+            assert time.time() - t0 < 8  # __exit__ did not join forever
+            assert isinstance(queued.exception(timeout=1), ShutdownError)
+            # second close, unbounded by argument: must return promptly
+            t0 = time.time()
+            assert q.close() is False
+            assert time.time() - t0 < 2
+            assert q.stats()["shutdown_stranded"] == 1  # not re-counted
+        finally:
+            release.set()
+
+    def test_close_without_timeout_still_drains(self, rng):
+        _, programs = self._programs(rng)
+        q = MicroBatchQueue(programs, max_linger_s=10.0)
+        futs = [q.submit(*self._request(rng)) for _ in range(5)]
+        assert q.close() is True
+        assert all(np.isfinite(f.result(timeout=1)) for f in futs)
+
+    def test_health_snapshot_fields(self, rng):
+        tables, programs = self._programs(rng)
+        with MicroBatchQueue(
+            programs, max_linger_s=0.001, shed_watermark=100,
+            breaker_threshold=8, default_deadline_s=5.0,
+        ) as q:
+            q.submit(*self._request(rng)).result(timeout=10)
+            health = q.health()
+        assert health["queue_depth"] == 0
+        assert health["requests"] == 1
+        assert health["breaker_open"] is False
+        assert health["shed"] == 0
+        assert health["deadline_expired"] == 0
+        assert health["dispatch_retries"] == 0
+        assert health["shed_watermark"] == 100
+        assert health["breaker_threshold"] == 8
+        assert health["table_generation"] == 0
+        # a reload bumps the generation the snapshot reports
+        tables.reload(_glmix_model(np.random.default_rng(5), scale=2.0))
+        assert q.health()["table_generation"] == 1
+
+    def test_clean_run_records_zero_degraded_events(self, rng):
+        """Acceptance: a clean serve run records ZERO sheds/retries/
+        deadline expiries/breaker activity."""
+        tables, programs = self._programs(rng)
+        reqs = synthetic_requests(tables, programs, 120, seed=3)
+        with MicroBatchQueue(
+            programs, max_linger_s=0.001, shed_watermark=4096,
+            breaker_threshold=8, default_deadline_s=30.0,
+        ) as q:
+            out = drive(q, reqs, warmup=20)
+        assert out["errors"] == 0
+        health = q.health()
+        for key in ("shed", "deadline_expired", "dispatch_retries",
+                    "dispatch_errors", "breaker_trips"):
+            assert health[key] == 0, (key, health)
