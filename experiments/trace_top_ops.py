@@ -89,10 +89,10 @@ def top_ops(xplane_path, top=30):
 
 def main():
     task = sys.argv[1] if len(sys.argv) > 1 else "logistic"
-    import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from photon_tpu.obs.trace import profile_session
     from photon_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
@@ -111,9 +111,11 @@ def main():
 
     fit()  # compile + load
     tracedir = tempfile.mkdtemp(prefix="jaxtrace")
-    jax.profiler.start_trace(tracedir)
-    fit()
-    jax.profiler.stop_trace()
+    # THE profiling entry point (obs/trace.py): the captured xplane
+    # profile is bracketed by an obs span + start/stop instants, so it
+    # correlates with the exported host timeline.
+    with profile_session(tracedir, name="trace_top_ops"):
+        fit()
     paths = glob.glob(os.path.join(
         tracedir, "plugins/profile/*/*.xplane.pb"))
     top_ops(paths[0])

@@ -345,6 +345,12 @@ class CoordinateDescent:
                         obs.REGISTRY.counter(
                             "coordinate_rollbacks_total", coordinate=cid
                         ).inc()
+                        from photon_tpu.obs import trace as obs_trace
+
+                        obs_trace.instant(
+                            "cd.rollback", cat="resilience",
+                            coordinate=cid, iteration=it,
+                        )
                     record = CoordinateUpdateRecord(
                         iteration=it,
                         coordinate_id=cid,

@@ -1009,6 +1009,99 @@ def build_telemetry() -> ContractTrace:
     )
 
 
+def build_trace() -> ContractTrace:
+    """The timeline layer's audited zero-overhead guarantee.
+
+    ``build_telemetry`` proves the span/metric/convergence surfaces add
+    nothing to the traced programs; this contract raises the same bar
+    for the TRACE layer on top of them (``obs/trace.py`` +
+    ``obs/flight.py``): the fused materialize + whole-fit programs are
+    traced with everything OFF (base) and then with the layer fully
+    ARMED — telemetry enabled, a flight recorder installed (its
+    excepthook + crash-listener chained, its counter baseline taken),
+    and the event ring actively receiving instants, counter samples,
+    and request records between the two traces. The
+    ``trace_toggle`` variant must be byte-identical to the base:
+    events are host-ring bookkeeping on the perf_counter clock, never
+    a traced operand, a callback, or a program split.
+    """
+    import tempfile
+
+    from photon_tpu import obs
+    from photon_tpu.obs import flight
+    from photon_tpu.obs import trace as obs_trace
+
+    with _serial_ingest_env():
+        est, data = _tiny_glmix()
+        datasets, _ = est.prepare(data)
+        coords = est._build_coordinates(
+            datasets, {}, {}, data.num_samples
+        )
+        fused = est._fused_for(coords, datasets)
+        was_enabled = obs.enabled()
+        obs.disable()
+        try:
+            mat_off = trace_program(
+                "materialize", fused._mat_jit, fused._mat_operands(coords)
+            )
+            traced_off = fused.trace(coords)
+            fit_off = TracedProgram(
+                name="fit",
+                text=str(traced_off.jaxpr),
+                jaxpr=traced_off.jaxpr,
+                lowered=traced_off.lower(),
+            )
+            # Arm the whole layer (install enables telemetry) and keep
+            # the ring HOT while the armed trace is taken.
+            tmpdir = tempfile.mkdtemp(prefix="photon-trace-audit-")
+            flight.install(tmpdir, signals=False)
+            try:
+                obs_trace.instant("audit.armed", cat="audit")
+                obs_trace.counter("audit_gauge", 1.0)
+                obs_trace.request({
+                    "id": 0, "outcome": "served",
+                    "submit_ts": 0.0, "done_ts": 0.0,
+                })
+                mat_on = trace_program(
+                    "materialize", fused._mat_jit,
+                    fused._mat_operands(coords),
+                )
+                traced_on = fused.trace(coords)
+                fit_on = TracedProgram(
+                    name="fit", text=str(traced_on.jaxpr)
+                )
+            finally:
+                flight.uninstall()
+                # The audit fed the PROCESS-GLOBAL ring (a phantom
+                # served request, audit instants) purely to arm the
+                # traced state — clean up behind it, or a later
+                # in-process consumer (request_summary, the exporters)
+                # sees audit debris on its timeline.
+                obs_trace.reset()
+                import shutil
+
+                shutil.rmtree(tmpdir, ignore_errors=True)
+        finally:
+            obs.TRACER.enabled = was_enabled
+    return ContractTrace(
+        programs={"materialize": mat_off, "fit": fit_off},
+        variants={
+            "trace_toggle": [
+                {
+                    "materialize": mat_on.signature,
+                    "fit": fit_on.signature,
+                }
+            ]
+        },
+        notes=[
+            "flight recorder installed + event ring receiving "
+            "instants/counters/request records traced the same "
+            "materialize/fit jaxprs as the all-off base: the timeline "
+            "layer is host bookkeeping only",
+        ],
+    )
+
+
 def build_serving() -> ContractTrace:
     """The serving score ladder's zero-recompile contract.
 
@@ -1274,6 +1367,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_mesh_sharding": build_mesh_sharding,
     "build_ingest_pipeline": build_ingest_pipeline,
     "build_telemetry": build_telemetry,
+    "build_trace": build_trace,
     "build_serving": build_serving,
     "build_resilience": build_resilience,
     "build_evaluators": build_evaluators,

@@ -72,6 +72,22 @@ def main(argv=None) -> int:
                         help="also write the summary JSON to PATH")
     parser.add_argument("--telemetry", default=None, metavar="PATH",
                         help="write the obs JSONL stream to PATH")
+    parser.add_argument("--trace", default=None, metavar="PATH",
+                        help="write the merged Chrome-trace/Perfetto "
+                             "timeline (host spans, counter tracks, "
+                             "per-request span trees) to PATH "
+                             "(OBSERVABILITY.md)")
+    parser.add_argument("--request-log", default=None, metavar="PATH",
+                        help="write the per-request JSONL stream "
+                             "(one record per served/expired/shed/"
+                             "breaker-failed request) to PATH")
+    parser.add_argument("--flight-dir", default=".", metavar="DIR",
+                        help="crash flight recorder destination: "
+                             "flight-<pid>.json is dumped there on "
+                             "SIGINT/SIGTERM, unhandled exceptions, and "
+                             "crash-kind injected faults")
+    parser.add_argument("--no-flight", action="store_true",
+                        help="disable the crash flight recorder")
     parser.add_argument("--backend", default=None)
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("--log-file", default=None)
@@ -107,6 +123,7 @@ def main(argv=None) -> int:
 
 def _run(args) -> int:
     from photon_tpu import obs
+    from photon_tpu.obs import flight
     from photon_tpu.utils import compile_event_count
 
     # Telemetry for the serve run, with the enabled flag left as found
@@ -115,9 +132,29 @@ def _run(args) -> int:
     was_enabled = obs.enabled()
     obs.reset()
     obs.enable()
+    # Crash flight recorder (obs/flight.py): SIGINT/SIGTERM are chained
+    # here (serve has no handlers of its own), unhandled exceptions and
+    # crash-kind injected faults dump via the block below / the faults
+    # listener — a dead serve process always leaves flight-<pid>.json.
+    rec = None
+    prior_rec = flight.installed()
+    if not args.no_flight:
+        rec = flight.install(args.flight_dir, signals=True)
     try:
         return _run_instrumented(args, obs, compile_event_count)
+    except BaseException as exc:
+        # In-process callers catch exceptions up-stack, so the chained
+        # sys.excepthook never fires for them — dump at the unwind.
+        if rec is not None and not isinstance(exc, SystemExit):
+            flight.dump(f"exception:{type(exc).__name__}")
+        raise
     finally:
+        if rec is not None:
+            flight.uninstall()
+            if prior_rec is not None:
+                # Our default-on install replaced an embedding caller's
+                # ambient recorder — hand it back re-armed.
+                flight.reinstall(prior_rec)
         obs.TRACER.enabled = was_enabled
 
 
@@ -239,6 +276,10 @@ def _run_instrumented(args, obs, compile_event_count) -> int:
     out.update(summary)
     if args.telemetry:
         obs.write_jsonl(args.telemetry)
+    if args.trace:
+        obs.write_chrome_trace(args.trace)
+    if args.request_log:
+        obs.trace.write_request_jsonl(args.request_log)
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2)

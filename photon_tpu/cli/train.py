@@ -51,6 +51,20 @@ def main(argv=None) -> int:
                              "training-summary.json (OBSERVABILITY.md). "
                              "Resets the process's telemetry stream: "
                              "the run owns its stream end to end")
+    parser.add_argument("--trace", default=None, metavar="PATH",
+                        help="write the merged Chrome-trace/Perfetto "
+                             "timeline (host spans, counter tracks, "
+                             "resilience events) to PATH at the end of "
+                             "the run (OBSERVABILITY.md)")
+    parser.add_argument("--flight-dir", default=None, metavar="DIR",
+                        help="crash flight recorder destination "
+                             "(default: the config's output_dir): "
+                             "flight-<pid>.json is dumped there when "
+                             "training is interrupted by SIGINT/SIGTERM, "
+                             "dies on an unhandled exception, or hits a "
+                             "crash-kind injected fault")
+    parser.add_argument("--no-flight", action="store_true",
+                        help="disable the crash flight recorder")
     args = parser.parse_args(argv)
     if (args.resume and args.checkpoint_dir
             and os.path.abspath(args.resume)
@@ -78,24 +92,64 @@ def main(argv=None) -> int:
         faults.arm_from_env()
         enable_compilation_cache()  # persistent XLA cache: warm runs skip compiles
         maybe_init_distributed()
-        if args.telemetry:
-            from photon_tpu import obs
+        from photon_tpu import obs
 
-            was_enabled = obs.enabled()
-            # DESTRUCTIVE by design: the --telemetry run owns the
-            # process's telemetry stream (a JSONL mixing a prior
+        was_enabled = obs.enabled()
+        if args.telemetry or args.trace:
+            # DESTRUCTIVE by design: the --telemetry/--trace run owns
+            # the process's telemetry stream (a JSONL mixing a prior
             # session's records into this run's artifact would be
             # worse); only the enabled flag is restored afterwards —
             # in-process callers who need their accumulated records
-            # must snapshot before invoking main().
+            # must snapshot before invoking main(). --trace enables
+            # too: an exported timeline from rings nothing ever wrote
+            # to would be an empty trace.json, silently.
             obs.reset()
             obs.enable()
+        from photon_tpu.obs import flight
+
+        # _run installs the CLI's own recorder (unless --no-flight);
+        # dump/uninstall below are gated on that install actually having
+        # happened, so an embedding caller's ambient recorder is never
+        # dumped to or torn down behind its back.
+        prior_rec = flight.installed()
         try:
             return _run(args)
+        except BaseException as exc:
+            # The flight recorder's chained sys.excepthook never fires
+            # for in-process callers (they catch up-stack): dump the
+            # post-mortem at the unwind. A SystemExit is an exit code,
+            # not a crash.
+            if (not isinstance(exc, SystemExit)
+                    and flight.installed() is not prior_rec):
+                flight.dump(f"exception:{type(exc).__name__}")
+            raise
         finally:
+            # Uninstall FIRST: it restores the telemetry flag to the
+            # state it found at install time (inside _run), and the
+            # --telemetry/--trace restore below must win over it.
+            if flight.installed() is not prior_rec:
+                flight.uninstall()
+                if prior_rec is not None:
+                    # _run's default-on install replaced an embedding
+                    # caller's ambient recorder: hand it back re-armed,
+                    # so the caller's post-mortem coverage survives.
+                    flight.reinstall(prior_rec)
+                elif not (args.telemetry or args.trace) and not was_enabled:
+                    # The flight install was the ONLY thing recording
+                    # (caller had telemetry off, asked for no exports):
+                    # drop this run's records instead of leaving them
+                    # to pollute the caller's next snapshot/JSONL.
+                    obs.reset()
+            if args.trace:
+                try:
+                    obs.write_chrome_trace(args.trace)
+                    logging.getLogger("photon.train").info(
+                        "chrome trace written to %s", args.trace)
+                except Exception:
+                    logging.getLogger("photon.train").exception(
+                        "failed to write trace to %s", args.trace)
             if args.telemetry:
-                from photon_tpu import obs
-
                 try:
                     obs.write_jsonl(args.telemetry)
                     logging.getLogger("photon.train").info(
@@ -109,6 +163,7 @@ def main(argv=None) -> int:
                     logging.getLogger("photon.train").exception(
                         "failed to write telemetry to %s", args.telemetry
                     )
+            if args.telemetry or args.trace:
                 # Restore the caller's prior ENABLED FLAG (the recorded
                 # stream was reset above, by design) so an in-process
                 # caller that keeps telemetry on — the bench's wide-d
@@ -135,16 +190,30 @@ def _run(args) -> int:
     )
     from photon_tpu.stat import FeatureDataStatistics
     from photon_tpu.types import TaskType
-    from photon_tpu.utils import profile_trace
 
     # Section timing rides the unified telemetry layer; obs.logged_span
     # keeps the reference's Timed/PhotonLogger "begin execution" /
     # "executed in" log contract for the --log-file sink.
     from photon_tpu import obs
+    from photon_tpu.obs import flight
 
     t_start = time.time()
     cfg = TrainingConfig.load(args.config)
     os.makedirs(cfg.output_dir, exist_ok=True)
+
+    # Crash flight recorder (obs/flight.py): the last N seconds of
+    # spans/events/metric deltas land in flight-<pid>.json when the run
+    # dies. Signals stay with THIS driver's own handlers below (they
+    # commit the emergency checkpoint); the interrupt path and main()'s
+    # unwind call flight.dump explicitly, and crash-kind injected
+    # faults dump through the faults.on_crash listener. Installing
+    # enables telemetry recording (host-side only — the audited
+    # zero-overhead contracts); main()'s finally uninstalls.
+    recorder = None
+    if not args.no_flight:
+        recorder = flight.install(
+            args.flight_dir or cfg.output_dir, signals=False
+        )
 
     # ------------------------------------------------------------------
     # read data (readTrainingData :537)
@@ -435,7 +504,8 @@ def _run(args) -> int:
         with obs.logged_span("prepare training datasets", log):
             estimator.prepare(train, validation, initial_model)
         with obs.logged_span("train models", log), \
-                profile_trace(cfg.profile_dir):
+                obs.profile_session(
+                    cfg.profile_dir, name="train_fit_profile"):
             results = estimator.fit(
                 train, validation, opt_seq,
                 initial_model=initial_model,
@@ -444,6 +514,14 @@ def _run(args) -> int:
             )
     except TrainingInterrupted as exc:
         log.error("training interrupted by signal %d", exc.signum)
+        # Post-mortem and recovery point commit together: the flight
+        # dump carries the timeline that explains WHERE the run was
+        # when the signal landed; the emergency checkpoint below
+        # carries the state to resume from. Gated on THIS CLI's own
+        # recorder — under --no-flight an embedding caller's ambient
+        # recorder must not be dumped to behind its back.
+        if recorder is not None:
+            recorder.dump(f"signal:{exc.signum}")
         if checkpointer is not None:
             path = checkpointer.write_emergency()
             if path:

@@ -34,6 +34,8 @@ import logging
 import time
 
 from photon_tpu.obs import convergence
+from photon_tpu.obs import flight
+from photon_tpu.obs import trace
 from photon_tpu.obs.export import (
     snapshot,
     summary_table,
@@ -46,29 +48,50 @@ from photon_tpu.obs.metrics import (
     metrics_listener,
 )
 from photon_tpu.obs.spans import Span, SpanTracer
+from photon_tpu.obs.trace import profile_session, write_chrome_trace
 
 TRACER = SpanTracer()
 span = TRACER.span
 
-# Program contract (audited by `python -m photon_tpu.analysis --semantic`;
-# machinery in analysis/program.py build_telemetry): the instrumented
-# public entry points — the fused materialize + whole-fit programs, the
-# ones every obs span and convergence trace hangs off — must trace to
-# BYTE-IDENTICAL jaxprs with telemetry enabled vs disabled. Zero new
-# dispatches (census bound is the fused generation's own 2 programs),
-# zero host callbacks (hot_loop), identical recompile keys
-# (stable_under=telemetry_toggle). Convergence metrics achieve this by
-# being UNCONDITIONAL outputs of the fit program: the enable flag only
-# controls host-side recording, never the trace.
-PROGRAM_AUDIT = dict(
-    name="telemetry",
-    entry="obs instrumentation over algorithm.fused_fit "
-    "(materialize + whole-fit programs, telemetry on vs off)",
-    builder="build_telemetry",
-    max_programs=2,
-    stable_under=("telemetry_toggle",),
-    hot_loop=True,
-)
+# Program contracts (audited by `python -m photon_tpu.analysis
+# --semantic`; machinery in analysis/program.py build_telemetry /
+# build_trace):
+#
+# - `telemetry`: the instrumented public entry points — the fused
+#   materialize + whole-fit programs, the ones every obs span and
+#   convergence trace hangs off — must trace to BYTE-IDENTICAL jaxprs
+#   with telemetry enabled vs disabled. Zero new dispatches (census
+#   bound is the fused generation's own 2 programs), zero host
+#   callbacks (hot_loop), identical recompile keys
+#   (stable_under=telemetry_toggle). Convergence metrics achieve this
+#   by being UNCONDITIONAL outputs of the fit program: the enable flag
+#   only controls host-side recording, never the trace.
+# - `trace`: the SAME bar for the timeline layer (obs/trace.py +
+#   obs/flight.py): with telemetry enabled, a flight recorder
+#   installed, and instants/counters/request records being emitted, the
+#   traced programs stay byte-identical to the all-off base
+#   (stable_under=trace_toggle) — events and dumps are host-ring
+#   bookkeeping, never a traced operand or callback.
+PROGRAM_AUDIT = [
+    dict(
+        name="telemetry",
+        entry="obs instrumentation over algorithm.fused_fit "
+        "(materialize + whole-fit programs, telemetry on vs off)",
+        builder="build_telemetry",
+        max_programs=2,
+        stable_under=("telemetry_toggle",),
+        hot_loop=True,
+    ),
+    dict(
+        name="trace",
+        entry="obs.trace event ring + obs.flight recorder over "
+        "algorithm.fused_fit (tracing fully armed vs off)",
+        builder="build_trace",
+        max_programs=2,
+        stable_under=("trace_toggle",),
+        hot_loop=True,
+    ),
+]
 
 
 @contextlib.contextmanager
@@ -105,11 +128,20 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    """Drop all recorded telemetry (spans, metrics, convergence traces).
-    Does not touch the enabled flag."""
+    """Drop all recorded telemetry (spans, metrics, convergence traces,
+    trace events). Does not touch the enabled flag."""
     TRACER.reset()
     REGISTRY.reset()
     convergence.reset()
+    trace.reset()
+
+
+def set_span_retention(max_spans: int) -> None:
+    """Rebind the completed-span ring's bound (default 4096; newest
+    spans kept). The trace-event ring has ``obs.trace.set_retention``;
+    drops feed the ``spans_dropped_total`` / ``trace_events_dropped_total``
+    registry counters as well as the snapshot/JSONL headers."""
+    TRACER.set_retention(max_spans)
 
 
 __all__ = [
@@ -123,12 +155,17 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "flight",
     "logged_span",
     "metrics_listener",
+    "profile_session",
     "reset",
+    "set_span_retention",
     "snapshot",
     "span",
     "summary_table",
+    "trace",
     "validate_jsonl",
+    "write_chrome_trace",
     "write_jsonl",
 ]

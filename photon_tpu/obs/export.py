@@ -23,6 +23,8 @@ JSONL SCHEMA (version 1) — one JSON object per line, discriminated by
   {"type": "series", "name": "convergence", "fit", "coordinate",
    "metric", "values": [float, ...]}
   {"type": "report", "name": "pipeline"|"compile_cache", "data": {}}
+  {"type": "request", "id", "outcome", "submit_ts", "done_ts",
+   ...segment timestamps for served requests}   # obs/trace.py
 """
 
 from __future__ import annotations
@@ -122,6 +124,9 @@ _REQUIRED_KEYS = {
     "histogram": ("series", "count", "sum", "min", "max"),
     "series": ("name", "fit", "coordinate", "metric", "values"),
     "report": ("name", "data"),
+    # Serving request records (obs/trace.py write_request_jsonl):
+    # outcome must come from trace.REQUEST_OUTCOMES, checked below.
+    "request": ("id", "outcome", "submit_ts", "done_ts"),
 }
 
 
@@ -173,6 +178,20 @@ def validate_jsonl(path: str) -> int:
                 raise ValueError(
                     f"{path}:{lineno}: series values must be a list"
                 )
+            if rtype == "request":
+                from photon_tpu.obs.trace import REQUEST_OUTCOMES
+
+                if rec["outcome"] not in REQUEST_OUTCOMES:
+                    raise ValueError(
+                        f"{path}:{lineno}: unknown request outcome "
+                        f"{rec['outcome']!r} (known: "
+                        f"{', '.join(REQUEST_OUTCOMES)})"
+                    )
+                if rec["done_ts"] < rec["submit_ts"]:
+                    raise ValueError(
+                        f"{path}:{lineno}: request done_ts precedes "
+                        "submit_ts"
+                    )
             n += 1
     if n == 0:
         raise ValueError(f"{path}: empty telemetry file")

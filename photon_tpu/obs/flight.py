@@ -1,0 +1,320 @@
+"""Crash flight recorder: the last N seconds of timeline, on disk, always.
+
+A crashed run used to leave nothing behind — the spans, events, and
+metric movement that explain the crash died with the process. The
+recorder fixes that the way an aircraft FDR does: the telemetry layer's
+bounded rings (completed spans, trace events) ARE the recording, and a
+dump writes their tails plus metric deltas to
+``flight-<pid>.json`` — atomically (tmp + fsync + rename, the
+checkpoint discipline of ``resilience/checkpoint.py``) so a dump
+interrupted by the dying process never leaves a half-written artifact.
+
+Dumps fire on:
+
+- **signals** — ``install(signals=True)`` chains SIGINT/SIGTERM: dump,
+  then the previous handler runs (or the default disposition is
+  restored and re-raised, so exit codes keep their signal semantics).
+  ``cli.train`` keeps its own handlers (they drive the emergency
+  checkpoint) and calls ``dump()`` explicitly from that path instead —
+  the post-mortem and the recovery point are committed together.
+- **unhandled exceptions** — ``install()`` chains ``sys.excepthook``.
+- **``crash``-kind injected faults** — a listener registered with
+  ``resilience.faults.on_crash`` dumps at the raise point, so chaos
+  runs always leave a post-mortem even when a caller catches
+  ``InjectedCrash``.
+
+Installing the recorder ENABLES telemetry recording (and uninstall
+restores the prior flag): a flight recorder with empty rings records
+nothing, and the recording it turns on is the audited zero-overhead
+host layer (the tier-2 ``telemetry``/``trace`` contracts) — never a
+device-side cost. The CLIs install it by default (``--no-flight`` opts
+out; ``--flight-dir`` picks the destination).
+
+Retention is whatever the rings hold (``obs.set_span_retention`` /
+``obs.trace.set_retention``), further clamped per dump by
+``span_limit``/``event_limit`` so a dump stays a readable post-mortem,
+not a full history.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal as _signal
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_SPAN_LIMIT = 512
+_DEFAULT_EVENT_LIMIT = 1024
+# How long a signal handler waits for the off-thread dump before
+# letting the process die post-mortem-less (see _on_signal).
+_SIGNAL_DUMP_TIMEOUT_S = 5.0
+
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`). The one installed-recorder reference is swapped under
+# the module lock (install/uninstall from the driver thread; dump reads
+# it from signal handlers, the excepthook, and the faults crash path on
+# whatever thread crashes). The dump itself runs on ring SNAPSHOTS and
+# writes files outside any lock.
+CONCURRENCY_AUDIT = dict(
+    name="obs-flight",
+    locks={
+        "_lock": ("_recorder",),
+    },
+    thread_entries=(),
+    jax_dispatch_ok={},
+)
+
+_lock = threading.Lock()
+_recorder: "FlightRecorder | None" = None
+
+
+class FlightRecorder:
+    """One installed recorder; use ``install()``/``uninstall()`` rather
+    than constructing directly (the module keeps the single reference
+    the signal/excepthook/crash paths consult)."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        span_limit: int = _DEFAULT_SPAN_LIMIT,
+        event_limit: int = _DEFAULT_EVENT_LIMIT,
+    ):
+        self.directory = directory
+        self.span_limit = int(span_limit)
+        self.event_limit = int(event_limit)
+        self.installed_unix = time.time()
+        # Counter baseline for the dump's deltas: "what moved since the
+        # recorder went in" is the post-mortem question.
+        from photon_tpu import obs
+
+        self._baseline = dict(obs.REGISTRY.snapshot()["counters"])
+        self._prev_enabled: bool | None = None
+        self._prev_handlers: dict = {}
+        self._prev_excepthook = None
+        self._crash_listener = None
+        # Both set by install(); reinstall re-arms with the same choices.
+        self._signals = False
+        self._enable = True
+
+    # -- dump ------------------------------------------------------------
+
+    def dump(self, reason: str) -> str | None:
+        """Write ``flight-<pid>.json`` atomically; returns the path, or
+        None if the dump failed (a failing dump must never mask the
+        crash it is documenting — it logs and returns)."""
+        try:
+            # THE shared tmp+fsync+replace+dir-fsync dance (PR 7) — a
+            # power loss right after the rename must not lose the one
+            # post-mortem, and a failed dump must not leave tmp debris.
+            from photon_tpu.io.model_io import atomic_write_bytes
+
+            payload = self._payload(reason)
+            os.makedirs(self.directory, exist_ok=True)
+            path = os.path.join(
+                self.directory, f"flight-{os.getpid()}.json"
+            )
+            atomic_write_bytes(path, json.dumps(payload).encode())
+            return path
+        except Exception:  # noqa: BLE001 — the crash path stays alive
+            logger.exception("flight-recorder dump failed (%s)", reason)
+            return None
+
+    def _payload(self, reason: str) -> dict:
+        """Assemble the post-mortem. Each section is independently
+        guarded: one wedged surface (a poisoned device array behind a
+        convergence fetch) must not cost the rest of the dump."""
+        from photon_tpu import obs
+        from photon_tpu.obs import trace as obs_trace
+
+        out: dict = {
+            "schema": 1,
+            "reason": reason,
+            "pid": os.getpid(),
+            "time_unix": time.time(),
+            "perf_counter": time.perf_counter(),
+            "installed_unix": self.installed_unix,
+        }
+        try:
+            spans = obs.TRACER.completed()[-self.span_limit:]
+            out["spans"] = [
+                dict(sp.to_json(), t0=sp.t0, t1=sp.t1) for sp in spans
+            ]
+            out["spans_dropped"] = obs.TRACER.dropped
+        except Exception as exc:  # noqa: BLE001
+            out["spans_error"] = repr(exc)
+        try:
+            out["events"] = obs_trace.events()[-self.event_limit:]
+            out["events_dropped"] = obs_trace.dropped()
+        except Exception as exc:  # noqa: BLE001
+            out["events_error"] = repr(exc)
+        try:
+            snap = obs.REGISTRY.snapshot()
+            out["metrics"] = snap
+            out["counter_deltas"] = {
+                k: v - self._baseline.get(k, 0.0)
+                for k, v in snap["counters"].items()
+                if v != self._baseline.get(k, 0.0)
+            }
+        except Exception as exc:  # noqa: BLE001
+            out["metrics_error"] = repr(exc)
+        try:
+            from photon_tpu.resilience import faults, retry_stats
+
+            out["retry_stats"] = retry_stats()
+            out["faults_fired"] = faults.fired()
+        except Exception as exc:  # noqa: BLE001
+            out["resilience_error"] = repr(exc)
+        return out
+
+    # -- hooks -----------------------------------------------------------
+
+    def _on_signal(self, signum, frame):
+        # dump() takes the tracer/ring/registry locks, and a Python
+        # signal handler runs on the main thread BETWEEN BYTECODES —
+        # possibly inside one of those very `with lock:` blocks (span
+        # completion is constant in a serving process). An inline dump
+        # would self-deadlock on the non-reentrant lock and the
+        # SIGTERM'd process would hang instead of dying. A daemon
+        # thread takes the locks safely (the main thread parks in the
+        # join, holding nothing in the common case); the bounded join
+        # gives up the post-mortem — never the exit — when the
+        # interrupted thread does hold one.
+        t = threading.Thread(
+            target=self.dump, args=(f"signal:{signum}",),
+            name="flight-signal-dump", daemon=True,
+        )
+        t.start()
+        t.join(timeout=_SIGNAL_DUMP_TIMEOUT_S)
+        if t.is_alive():  # pragma: no cover — needs a lock-holding race
+            logger.error(
+                "flight-recorder dump wedged on signal %d; exiting "
+                "without a post-mortem", signum,
+            )
+        prev = self._prev_handlers.get(signum)
+        if prev is _signal.SIG_IGN:
+            return
+        if callable(prev):
+            prev(signum, frame)
+            return
+        # Default disposition: restore it and re-raise so the process
+        # dies with the signal's own exit semantics (a SIGTERM'd serve
+        # process must still read as SIGTERM'd to its supervisor).
+        _signal.signal(signum, prev if prev is not None else _signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    def _on_exception(self, exc_type, exc, tb):
+        self.dump(f"exception:{exc_type.__name__}")
+        hook = self._prev_excepthook or sys.__excepthook__
+        hook(exc_type, exc, tb)
+
+    def _on_crash_fault(self, point: str, message: str) -> None:
+        self.dump(f"fault.crash:{point}")
+
+
+def install(
+    directory: str,
+    *,
+    signals: bool = False,
+    enable: bool = True,
+    span_limit: int = _DEFAULT_SPAN_LIMIT,
+    event_limit: int = _DEFAULT_EVENT_LIMIT,
+) -> FlightRecorder:
+    """Install the process flight recorder (replacing any prior one —
+    ``reinstall`` hands a replaced recorder back).
+
+    Chains ``sys.excepthook`` and the ``resilience.faults`` crash-fault
+    listener; ``signals=True`` additionally chains SIGINT/SIGTERM (the
+    serve CLI's mode — the train CLI keeps its own handlers and dumps
+    from its emergency-checkpoint path). ``enable=True`` (default) turns
+    telemetry recording on so the rings have content; the prior flag is
+    restored on ``uninstall``.
+    """
+    rec = FlightRecorder(
+        directory, span_limit=span_limit, event_limit=event_limit
+    )
+    rec._signals = bool(signals)
+    rec._enable = bool(enable)
+    return _arm(rec, enable=enable)
+
+
+def reinstall(rec: FlightRecorder) -> FlightRecorder:
+    """Re-arm a previously-uninstalled recorder: same directory, limits,
+    counter baseline, signal mode, and enable choice (an ambient
+    recorder installed with ``enable=False`` stays recording-off); every
+    hook re-chained against the CURRENT process state. How the CLIs
+    hand an embedding caller's ambient recorder back after their own
+    default-on install replaced it — the caller's post-mortem coverage
+    survives the nested run."""
+    return _arm(rec, enable=rec._enable)
+
+
+def _arm(rec: FlightRecorder, *, enable: bool) -> FlightRecorder:
+    from photon_tpu import obs
+    from photon_tpu.resilience import faults
+
+    uninstall()
+    rec._prev_enabled = obs.enabled()
+    if enable:
+        obs.enable()
+    rec._prev_excepthook = sys.excepthook
+    sys.excepthook = rec._on_exception
+    rec._crash_listener = rec._on_crash_fault
+    faults.on_crash(rec._crash_listener)
+    rec._prev_handlers = {}
+    if rec._signals:
+        for sig in (_signal.SIGINT, _signal.SIGTERM):
+            try:
+                rec._prev_handlers[sig] = _signal.signal(
+                    sig, rec._on_signal
+                )
+            except ValueError:  # pragma: no cover — non-main-thread embed
+                pass
+    with _lock:
+        global _recorder
+        _recorder = rec
+    return rec
+
+
+def uninstall() -> None:
+    """Remove the installed recorder and restore every chained hook
+    (telemetry flag, excepthook, signal handlers, crash listener).
+    Idempotent."""
+    with _lock:
+        global _recorder
+        rec, _recorder = _recorder, None
+    if rec is None:
+        return
+    from photon_tpu import obs
+    from photon_tpu.resilience import faults
+
+    if rec._crash_listener is not None:
+        faults.remove_crash_listener(rec._crash_listener)
+    if sys.excepthook == rec._on_exception:
+        sys.excepthook = rec._prev_excepthook or sys.__excepthook__
+    for sig, prev in rec._prev_handlers.items():
+        try:
+            # A prior handler installed from C reads back as None —
+            # signal.signal(None) is a TypeError; SIG_DFL is the same
+            # substitution _on_signal's re-raise path makes.
+            _signal.signal(sig, prev if prev is not None else _signal.SIG_DFL)
+        except ValueError:  # pragma: no cover
+            pass
+    if rec._prev_enabled is not None:
+        obs.TRACER.enabled = rec._prev_enabled
+
+
+def installed() -> "FlightRecorder | None":
+    return _recorder
+
+
+def dump(reason: str) -> str | None:
+    """Dump via the installed recorder; no-op (None) when none is
+    installed — call sites wire it unconditionally."""
+    rec = _recorder
+    return rec.dump(reason) if rec is not None else None

@@ -37,11 +37,13 @@ import threading
 import time
 from collections import deque
 
-# Retention bound on completed spans — the same concern that caps
-# convergence traces: a long telemetry-on production run (or the bench's
-# steady-state loop) must not grow host memory linearly. Oldest spans
-# drop first; the tracer counts drops so exporters can say so instead of
-# silently under-reporting.
+# Default retention bound on completed spans — the same concern that
+# caps convergence traces: a long telemetry-on production run (or the
+# bench's steady-state loop) must not grow host memory linearly. Oldest
+# spans drop first; the tracer counts drops (and feeds the
+# `spans_dropped_total` registry counter) so exporters can say so
+# instead of silently under-reporting. Configurable per tracer via
+# ``SpanTracer.set_retention`` / ``obs.set_span_retention``.
 _MAX_SPANS = 4096
 
 # Host-concurrency contract (audited by `python -m photon_tpu.analysis
@@ -132,6 +134,24 @@ class SpanTracer:
             self._spans.clear()
             self.dropped = 0
 
+    def set_retention(self, max_spans: int) -> None:
+        """Rebind the completed-span ring to a new bound (the newest
+        spans are kept). Spans a shrinking bound evicts count as drops —
+        the same accounting as ring overflow. The trace-event ring has
+        the analogous ``obs.trace.set_retention``."""
+        if max_spans < 1:
+            raise ValueError(
+                f"span retention must be >= 1, got {max_spans}"
+            )
+        with self._lock:
+            evicted = max(0, len(self._spans) - int(max_spans))
+            self._spans = deque(self._spans, maxlen=int(max_spans))
+            self.dropped += evicted
+        if evicted:
+            from photon_tpu.obs.metrics import REGISTRY
+
+            REGISTRY.counter("spans_dropped_total").inc(evicted)
+
     def completed(self) -> list[Span]:
         """Snapshot of the completed spans (record order; bounded to the
         most recent _MAX_SPANS — ``dropped`` counts the evicted)."""
@@ -188,10 +208,20 @@ class SpanTracer:
                 sp.t1 = t1
                 sp.seconds = t1 - sp.t0
                 stack.pop()
+                evicted = False
                 with self._lock:
                     if len(self._spans) == self._spans.maxlen:
                         self.dropped += 1
+                        evicted = True
                     self._spans.append(sp)
+                if evicted:
+                    # Outside the tracer lock (never nest it with the
+                    # registry's): retention pressure is a REAL metric —
+                    # the snapshot header's spans_dropped only says what
+                    # was lost, the counter makes it alertable.
+                    from photon_tpu.obs.metrics import REGISTRY
+
+                    REGISTRY.counter("spans_dropped_total").inc()
 
 
 def aggregate(spans: list[Span]) -> dict[str, dict]:

@@ -48,6 +48,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import json
+import logging
 import os
 import threading
 import time
@@ -64,15 +65,17 @@ from photon_tpu.resilience.errors import (
 # Host-concurrency contract (audited by `python -m photon_tpu.analysis
 # --concurrency`). The armed plan is read/advanced from every pool the
 # runtime owns (plan/chunk/compile workers, the serve worker, the
-# training thread); `_lock` guards the active-plan reference and the
-# plan's call counters / fired log, so nth-call accounting is exact
-# under concurrency. `check` reads the bare reference FIRST and returns
-# without touching the lock when nothing is armed — the clean-run hot
-# path takes no lock. Injected sleeps/raises happen OUTSIDE the lock.
+# training thread); `_lock` guards the active-plan reference, the
+# plan's call counters / fired log, and the crash-listener registry, so
+# nth-call accounting is exact under concurrency. `check` reads the
+# bare reference FIRST and returns without touching the lock when
+# nothing is armed — the clean-run hot path takes no lock. Injected
+# sleeps/raises — and crash-listener callbacks (the flight recorder's
+# dump) — happen OUTSIDE the lock.
 CONCURRENCY_AUDIT = dict(
     name="fault-injection",
     locks={
-        "_lock": ("_active", "_counts", "_fired"),
+        "_lock": ("_active", "_counts", "_fired", "_crash_listeners"),
     },
     thread_entries=(),
     jax_dispatch_ok={},
@@ -196,6 +199,28 @@ class FaultPlan:
 
 _lock = threading.Lock()
 _active: FaultPlan | None = None
+# Crash-fault listeners: called (point, message) at the raise point of a
+# `crash`-kind fault, BEFORE InjectedCrash propagates — how the flight
+# recorder (obs/flight.py) guarantees a post-mortem even when a caller
+# catches the crash. Registration is lock-guarded; callbacks run outside
+# the lock and must never raise into the fault path (logged instead).
+_crash_listeners: list = []
+
+
+def on_crash(fn) -> None:
+    """Register ``fn(point, message)`` to run when a ``crash``-kind
+    fault fires (at the raise point, before ``InjectedCrash``)."""
+    with _lock:
+        _crash_listeners.append(fn)
+
+
+def remove_crash_listener(fn) -> None:
+    """Unregister a crash listener. Idempotent."""
+    with _lock:
+        try:
+            _crash_listeners.remove(fn)
+        except ValueError:
+            pass
 
 
 def arm(plan: FaultPlan) -> None:
@@ -240,6 +265,20 @@ def arm_from_env(env_var: str = ENV_VAR) -> FaultPlan | None:
     return plan
 
 
+def _fault_instant(point: str, error: str) -> None:
+    """Mark a fired fault on the trace timeline (no-op when telemetry is
+    disabled or the obs layer is unimportable in a stripped embed)."""
+    try:
+        from photon_tpu.obs import trace as obs_trace
+
+        obs_trace.instant(
+            "fault.fired", cat="fault", point=point, error=error
+        )
+    except Exception:  # pragma: no cover — telemetry must never alter
+        # the injected fault's semantics.
+        pass
+
+
 def fired() -> list[dict]:
     """Snapshot of the active plan's fired-fault log (empty when no
     plan is armed or nothing fired) — the chaos assertions' evidence."""
@@ -263,11 +302,22 @@ def check(point: str) -> None:
     if spec is None:
         return
     msg = spec.message or f"injected {spec.error} fault at {point}"
+    _fault_instant(point, spec.error)
     if spec.error == "transient":
         raise TransientError(msg)
     if spec.error == "poison":
         raise PoisonError(msg)
     if spec.error == "crash":
+        with _lock:
+            listeners = list(_crash_listeners)
+        for fn in listeners:
+            try:
+                fn(point, msg)
+            except Exception:  # noqa: BLE001 — a listener (the flight
+                # recorder's dump) must never replace the injected crash
+                # the chaos run is testing for.
+                logging.getLogger(__name__).exception(
+                    "crash-fault listener raised at %s", point)
         raise InjectedCrash(msg)
     if spec.error == "sigterm":
         import signal

@@ -137,6 +137,17 @@ def _metric(name: str, site: str, value: float = 1.0) -> None:
         pass
 
 
+def _instant(name: str, **args) -> None:
+    """Mark a retry event on the trace timeline (obs/trace.py) — a
+    no-op when telemetry is disabled."""
+    try:
+        from photon_tpu.obs import trace as obs_trace
+
+        obs_trace.instant(name, cat="retry", **args)
+    except Exception:  # pragma: no cover
+        pass
+
+
 def call_with_retry(
     fn,
     *,
@@ -175,8 +186,13 @@ def call_with_retry(
             _record("retries" if attempt < policy.max_attempts
                     else "exhausted")
             _metric("retry_attempts_total", site)
+            _instant(
+                "retry.attempt", site=site, attempt=attempt,
+                error=type(exc).__name__,
+            )
             if attempt >= policy.max_attempts:
                 _metric("retry_exhausted_total", site)
+                _instant("retry.exhausted", site=site, attempt=attempt)
                 logger.warning(
                     "%s: transient failure persisted through %d "
                     "attempt(s): %r", site, attempt, exc)

@@ -224,4 +224,11 @@ def drive(
     out["dispatch_errors"] = (
         qstats["dispatch_errors"] - warm_stats["dispatch_errors"]
     )
+    from photon_tpu import obs
+
+    if obs.enabled():
+        # Request-scoped trace rollup (outcome counts + mean segment
+        # milliseconds over the ring's records — warmup included; the
+        # full per-request stream is obs.trace.write_request_jsonl).
+        out["request_trace"] = obs.trace.request_summary()
     return out

@@ -45,6 +45,16 @@ out, and a retry's backoff does stack onto that batch's latency. Pass
   error / retry / breaker counters, coefficient-table generation — the
   CLI and bench surface it.
 
+Request-scoped tracing (``photon_tpu.obs.trace``): with telemetry
+enabled, every ``submit`` mints a process-unique request id and every
+request resolves to exactly one trace record — outcome ``served``,
+``expired``, ``shed``, ``breaker``, ``closed``, ``error``, or
+``shutdown`` — with served requests carrying the
+queue-wait → batch-fill → dispatch → scatter segment timestamps that
+render as per-request async span trees in the exported ``trace.json``
+(OBSERVABILITY.md). Telemetry off, each boundary is one flag check and
+nothing is recorded.
+
 Shutdown drains: ``close()`` wakes the worker, which keeps flushing
 until the queue is empty, then exits; every in-flight future resolves.
 ``close(timeout=...)`` bounds the drain: if the worker is wedged in a
@@ -59,6 +69,7 @@ serving subsequent batches).
 from __future__ import annotations
 
 import collections
+import itertools
 import logging
 import threading
 import time
@@ -131,9 +142,17 @@ class QueueClosed(RuntimeError):
     """submit() after close()."""
 
 
+# Request ids are minted at submit (every submit, including rejected
+# ones) so EVERY request — served, expired, shed, breaker-failed —
+# yields exactly one trace record under a process-unique id
+# (obs/trace.py request-span taxonomy, OBSERVABILITY.md).
+_REQUEST_IDS = itertools.count(1)
+
+
 class _Request:
     __slots__ = (
-        "features", "entity_ids", "future", "enqueued_at", "deadline"
+        "features", "entity_ids", "future", "enqueued_at", "deadline",
+        "rid", "take_ts",
     )
 
     def __init__(self, features: dict, entity_ids: dict,
@@ -141,11 +160,36 @@ class _Request:
         self.features = features
         self.entity_ids = entity_ids
         self.future = _Future()
+        self.rid = next(_REQUEST_IDS)
         self.enqueued_at = time.perf_counter()
+        # Stamped (telemetry on only) when the worker pops the request
+        # into a batch: submit→take is the queue_wait trace segment.
+        self.take_ts: float | None = None
         self.deadline = (
             None if deadline_s is None
             else self.enqueued_at + float(deadline_s)
         )
+
+
+def _record_request(req: _Request, outcome: str, **extra) -> None:
+    """Emit one request-scoped trace record (no-op when telemetry is
+    disabled). ``extra`` carries the served path's segment timestamps
+    (``dispatch_ts``/``scatter_ts``/``batch``/``batch_size``) or the
+    failure path's ``error``."""
+    from photon_tpu import obs
+
+    if not obs.enabled():
+        return
+    rec = {
+        "id": req.rid,
+        "outcome": outcome,
+        "submit_ts": req.enqueued_at,
+        "done_ts": time.perf_counter(),
+    }
+    if req.take_ts is not None:
+        rec["take_ts"] = req.take_ts
+    rec.update(extra)
+    obs.trace.request(rec)
 
 
 class _Future:
@@ -326,35 +370,48 @@ class MicroBatchQueue:
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         req = _Request(features, dict(entity_ids or {}), deadline_s)
+        rejection = None  # (outcome, exc), resolved OUTSIDE the lock
         with self._cond:
             while True:
                 if self._closed:
                     self._stats["rejected"] += 1
-                    raise QueueClosed("serve queue is closed")
+                    rejection = (
+                        "closed", QueueClosed("serve queue is closed"))
+                    break
                 if self._breaker_open:
                     self._stats["breaker_rejected"] += 1
-                    raise CircuitOpenError(
+                    rejection = ("breaker", CircuitOpenError(
                         "serve dispatch circuit breaker is open "
                         f"(tripped after {self.breaker_threshold} "
                         "consecutive batch failures); reset_breaker() "
-                        "to resume")
+                        "to resume"))
+                    break
                 if (
                     self.shed_watermark is not None
                     and len(self._pending) >= self.shed_watermark
                 ):
                     self._stats["shed"] += 1
-                    raise OverloadedError(
+                    rejection = ("shed", OverloadedError(
                         f"serve queue depth {len(self._pending)} is at "
                         f"the shed watermark {self.shed_watermark}; "
-                        "request rejected instead of queued")
+                        "request rejected instead of queued"))
+                    break
                 if len(self._pending) < self.max_queue:
                     break
                 self._cond.wait()
-            if req.deadline is not None:
-                self._has_deadlines = True
-            self._pending.append(req)
-            self._stats["requests"] += 1
-            self._cond.notify_all()
+            if rejection is None:
+                if req.deadline is not None:
+                    self._has_deadlines = True
+                self._pending.append(req)
+                self._stats["requests"] += 1
+                self._cond.notify_all()
+        if rejection is not None:
+            # Trace emission (ring lock, registry lock on eviction)
+            # stays off the queue lock — overload, the exact state that
+            # takes these paths, is when the cond is hottest.
+            outcome, exc = rejection
+            _record_request(req, outcome)
+            raise exc
         return req.future
 
     def close(self, timeout: float | None = None) -> bool:
@@ -403,6 +460,7 @@ class MicroBatchQueue:
             "request abandoned before dispatch")
         for r in stranded:
             r.future.set_exception(exc)
+            _record_request(r, "shutdown")
         return False
 
     def reset_breaker(self) -> None:
@@ -551,6 +609,14 @@ class MicroBatchQueue:
                     if batch:
                         self._stats["batches"] += 1
                         self._stats["batched_requests"] += len(batch)
+                        from photon_tpu import obs
+
+                        if obs.enabled():
+                            # submit→take is the queue_wait segment of
+                            # every batched request's span tree.
+                            now = time.perf_counter()
+                            for r in batch:
+                                r.take_ts = now
                     self._cond.notify_all()  # space freed: wake producers
                     return batch, expired
                 if self._closed or expired:
@@ -566,6 +632,7 @@ class MicroBatchQueue:
                     "fast before dispatch")
                 for r in expired:
                     r.future.set_exception(exc)
+                    _record_request(r, "expired")
                 from photon_tpu import obs
 
                 if obs.enabled():
@@ -586,8 +653,14 @@ class MicroBatchQueue:
         from photon_tpu import obs
 
         t0 = time.perf_counter()
+        # Segment stamps for the request span trees (take→dispatch is
+        # batch_fill, dispatch→scatter is the device round trip). A
+        # retried dispatch keeps the LAST attempt's stamps — the one
+        # that produced the scores the requests were served from.
+        dispatch_ts = scatter_ts = None
 
         def attempt():
+            nonlocal dispatch_ts, scatter_ts
             feats, codes, _rung = self.programs.pack_requests(
                 [(r.features, r.entity_ids) for r in batch]
             )
@@ -595,10 +668,12 @@ class MicroBatchQueue:
                 int(np.sum(vec[: len(batch)] < 0))
                 for vec in codes.values()
             )
+            dispatch_ts = time.perf_counter()
             with obs.span("serve/batch"):
                 scores = self.programs.score_padded(
                     feats, codes, len(batch)
                 )
+            scatter_ts = time.perf_counter()
             return cold, len(codes) * len(batch), scores
 
         def on_retry(attempt_no, exc):
@@ -639,6 +714,10 @@ class MicroBatchQueue:
                     self._cond.notify_all()
             for r in batch:
                 r.future.set_exception(exc)
+                _record_request(
+                    r, "error", error=type(exc).__name__,
+                    batch_size=len(batch),
+                )
             if tripped:
                 logger.error(
                     "serve dispatch circuit breaker OPEN after %d "
@@ -650,13 +729,21 @@ class MicroBatchQueue:
                     f"request was queued (last failure: {exc!r})")
                 for r in drained:
                     r.future.set_exception(drain_exc)
+                    _record_request(r, "breaker")
                 if obs.enabled():
                     obs.REGISTRY.counter("serve_breaker_trips_total").inc()
+                    obs.trace.instant(
+                        "serve.breaker_open", cat="serve",
+                        consecutive_failures=self._consecutive_failures,
+                        drained=len(drained),
+                    )
             return
         with self._cond:
             self._consecutive_failures = 0
             self._stats["cold_lookups"] += cold
             self._stats["entity_lookups"] += lookups
+            batch_no = self._stats["batches"]
+            depth = len(self._pending)
         if obs.enabled():
             obs.REGISTRY.counter("serve_requests_total").inc(len(batch))
             obs.REGISTRY.counter("serve_batches_total").inc()
@@ -668,5 +755,15 @@ class MicroBatchQueue:
             obs.REGISTRY.histogram("serve_batch_seconds").observe(
                 time.perf_counter() - t0
             )
+            # Queue depth after each batch: a counter track on the
+            # exported timeline (how the backlog breathes under load).
+            obs.trace.counter("serve_queue_depth", depth)
         for r, s in zip(batch, scores):
             r.future.set_result(float(s))
+            # done_ts lands AFTER resolution: scatter→done covers the
+            # result fan-out including the driver's done-callbacks.
+            _record_request(
+                r, "served",
+                dispatch_ts=dispatch_ts, scatter_ts=scatter_ts,
+                batch=batch_no, batch_size=len(batch),
+            )

@@ -11,8 +11,11 @@
     emits a :class:`DeprecationWarning` (hidden by default; visible
     under ``-W error::DeprecationWarning``).
 
-``profile_trace`` remains the ``jax.profiler.trace`` wrapper for real
-device traces (the capability the reference delegates to the Spark UI).
+``profile_trace`` is likewise a deprecated shim: the one profiling
+entry point is now ``photon_tpu.obs.trace.profile_session``, which runs
+the same ``jax.profiler.trace`` capture INSIDE an obs span bracketed by
+``profile.start``/``profile.stop`` instants, so the captured device
+profile is correlated with the exported host timeline by construction.
 """
 
 from __future__ import annotations
@@ -69,13 +72,22 @@ class Timed:
 def profile_trace(trace_dir: str | None):
     """Wrap a block in ``jax.profiler.trace`` when a directory is given.
 
-    Produces a TensorBoard-loadable device trace; a None directory is a
-    no-op so call sites can wire it unconditionally.
+    .. deprecated::
+        Shim over :func:`photon_tpu.obs.trace.profile_session` — THE
+        profiling entry point, which additionally correlates the
+        captured device profile with the obs span timeline. A None
+        directory remains a no-op that never touches jax.
     """
     if not trace_dir:
         yield
         return
-    import jax
+    warnings.warn(
+        "photon_tpu.utils.profile_trace is deprecated; use "
+        "photon_tpu.obs.trace.profile_session (see OBSERVABILITY.md)",
+        DeprecationWarning,
+        stacklevel=3,
+    )
+    from photon_tpu.obs.trace import profile_session
 
-    with jax.profiler.trace(trace_dir):
+    with profile_session(trace_dir):
         yield

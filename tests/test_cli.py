@@ -121,6 +121,27 @@ class TestTrainCLI:
         assert summary["telemetry"]["spans"]
         assert not obs.enabled()  # left as found
 
+    def test_trace_flag_alone_writes_nonempty_timeline(
+        self, tmp_path, glmix_avro, capsys
+    ):
+        """--trace without --telemetry (and with the flight recorder —
+        the other telemetry enabler — opted out) still records: the
+        exported trace.json validates and carries host spans."""
+        from photon_tpu import obs
+        from photon_tpu.cli.train import main
+        from photon_tpu.obs.trace import validate_chrome_trace
+
+        train, val = glmix_avro
+        cfg_path, _ = _config(tmp_path, train, val)
+        t_path = tmp_path / "trace.json"
+        assert main(["--config", str(cfg_path), "--no-flight",
+                     "--trace", str(t_path)]) == 0
+        capsys.readouterr()
+        assert validate_chrome_trace(str(t_path)) > 0
+        doc = json.loads(t_path.read_text())
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+        assert not obs.enabled()  # left as found
+
     def test_lambda_grid_selects_best(self, tmp_path, glmix_avro, capsys):
         from photon_tpu.cli.train import main
 

@@ -1098,6 +1098,7 @@ class TestNonFiniteGuard:
         )
 
         events = []
+        was_enabled = obs.enabled()
         obs.reset()
         obs.enable()
         try:
@@ -1121,7 +1122,11 @@ class TestNonFiniteGuard:
                 for k in counters
             ), counters
         finally:
+            # reset() drops records but never touches the enabled flag:
+            # restore it too, or the leak trips test_cli's "left as
+            # found" telemetry assertion when this file runs first.
             obs.reset()
+            obs.TRACER.enabled = was_enabled
 
     def test_first_update_non_finite_raises(self):
         coord = _SyntheticCoordinate(poison_calls={1})

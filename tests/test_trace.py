@@ -1,0 +1,686 @@
+"""photon_tpu.obs.trace + obs.flight: one timeline for everything.
+
+Covers the PR-8 acceptance surface:
+- the trace-event ring (instants / counters / request records), its
+  bounded retention, and the drop counters that make retention pressure
+  alertable (`spans_dropped_total` / `trace_events_dropped_total`);
+- the Chrome-trace/Perfetto exporter: round-trip export -> validate ->
+  chrome-trace JSON schema, with host spans, counter tracks, and
+  per-request async span trees on one clock;
+- request-scoped serving traces: every queue outcome (served / expired /
+  shed / closed / error) yields exactly one record; served requests
+  carry monotonic queue-wait -> batch-fill -> dispatch -> scatter
+  stamps; the per-request JSONL stream validates under the shared
+  `validate_jsonl` schema;
+- the crash flight recorder: dump contents, dump on crash-kind injected
+  faults (the `faults.on_crash` listener), chained excepthook,
+  uninstall restoring every hook, and the real-subprocess SIGTERM dump
+  through `photon train` (the PR-7 pattern);
+- `profile_session` as THE profiling entry point (and the deprecated
+  `utils.profile_trace` shim over it);
+- the `measured_vs_roofline` bench gate tripping on a deliberately
+  slowed fixture (ROADMAP item 2's gating half).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from photon_tpu import obs
+from photon_tpu.obs import flight
+from photon_tpu.obs import trace
+from photon_tpu.resilience import FaultPlan, InjectedCrash, faults
+from photon_tpu.resilience.retry import reset_retry_stats
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+D, DU, E, S = 6, 5, 9, 3
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260803)
+
+
+@pytest.fixture
+def telemetry():
+    """Telemetry on, rings clean; everything restored afterwards."""
+    was = obs.enabled()
+    obs.reset()
+    obs.enable()
+    yield obs
+    obs.TRACER.enabled = was
+    obs.set_span_retention(4096)
+    trace.set_retention(8192)
+    obs.reset()
+
+
+def _glmix_model(rng):
+    """The test_serve fixture shape: one dense fixed effect + one
+    random effect with a sorted per-entity projector."""
+    import jax.numpy as jnp
+
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.types import TaskType
+
+    prng = np.random.default_rng(1234)
+    proj = np.sort(
+        np.stack([prng.permutation(DU)[:S] for _ in range(E)]), axis=1
+    ).astype(np.int64)
+    return GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(means=jnp.asarray(
+                    rng.normal(size=D).astype(np.float32))),
+                TaskType.LINEAR_REGRESSION,
+            ),
+            "features",
+        ),
+        "per-user": RandomEffectModel(
+            coefficients=jnp.asarray(
+                rng.normal(size=(E, S)).astype(np.float32)),
+            random_effect_type="userId",
+            feature_shard_id="userShard",
+            task=TaskType.LINEAR_REGRESSION,
+            proj_all=proj,
+            entity_keys=tuple(str(i) for i in range(E)),
+        ),
+    })
+
+
+def _programs(rng, rungs=(1, 4)):
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+
+    tables = CoefficientTables.from_game_model(_glmix_model(rng))
+    return ScorePrograms(tables, ladder=ShapeLadder(rungs))
+
+
+def _request(rng, user="1"):
+    return (
+        {
+            "features": rng.normal(size=D).astype(np.float32),
+            "userShard": rng.normal(size=DU).astype(np.float32),
+        },
+        {"userId": user},
+    )
+
+
+# --------------------------------------------------------------------------
+# the event ring
+# --------------------------------------------------------------------------
+
+
+class TestEventRing:
+    def test_disabled_records_nothing(self):
+        was = obs.enabled()
+        obs.disable()
+        obs.reset()
+        try:
+            trace.instant("x")
+            trace.counter("c", 1.0)
+            trace.request({"id": 1, "outcome": "served",
+                           "submit_ts": 0.0, "done_ts": 0.0})
+            assert trace.events() == []
+        finally:
+            obs.TRACER.enabled = was
+
+    def test_overflow_counts_drops_and_feeds_registry(self, telemetry):
+        trace.set_retention(3)
+        for i in range(7):
+            trace.instant(f"e{i}")
+        assert len(trace.events()) == 3
+        assert trace.dropped() == 4
+        # Retention pressure is a REAL metric, not only a header field.
+        counters = obs.REGISTRY.snapshot()["counters"]
+        assert counters["trace_events_dropped_total"] == 4
+        # newest survive
+        assert [e["name"] for e in trace.events()] == ["e4", "e5", "e6"]
+
+    def test_set_retention_rejects_nonpositive(self):
+        with pytest.raises(ValueError):
+            trace.set_retention(0)
+
+    def test_span_retention_configurable_and_counted(self, telemetry):
+        obs.set_span_retention(2)
+        for i in range(5):
+            with obs.span(f"s{i}"):
+                pass
+        assert len(obs.TRACER.completed()) == 2
+        assert obs.TRACER.dropped == 3
+        counters = obs.REGISTRY.snapshot()["counters"]
+        assert counters["spans_dropped_total"] == 3
+
+    def test_reset_clears_ring(self, telemetry):
+        trace.instant("x")
+        obs.reset()
+        assert trace.events() == []
+        assert trace.dropped() == 0
+
+
+# --------------------------------------------------------------------------
+# chrome-trace export
+# --------------------------------------------------------------------------
+
+
+class TestChromeTrace:
+    def test_round_trip_export_validate_load(self, telemetry, tmp_path):
+        with obs.span("host_section"):
+            trace.instant("marker", cat="test", detail=1)
+        trace.counter("depth", 3.0)
+        trace.request({
+            "id": 7, "outcome": "served",
+            "submit_ts": 1.0, "take_ts": 1.1, "dispatch_ts": 1.2,
+            "scatter_ts": 1.3, "done_ts": 1.4,
+            "batch": 1, "batch_size": 2,
+        })
+        path = str(tmp_path / "trace.json")
+        n = obs.write_chrome_trace(path)
+        assert trace.validate_chrome_trace(path) == n
+        doc = json.load(open(path))
+        evs = doc["traceEvents"]
+        phases = {e["ph"] for e in evs}
+        assert {"X", "i", "C", "b", "e", "M"} <= phases
+        # host span on a named thread track
+        meta = [e for e in evs if e["ph"] == "M"]
+        assert any(e["args"]["name"] for e in meta)
+        spans = [e for e in evs if e["ph"] == "X"]
+        assert any(e["name"] == "host_section" for e in spans)
+        # the request renders as an async tree: root + 4 segments,
+        # all grouped under one id
+        req = [e for e in evs if e.get("cat") == "serve.request"]
+        assert {e["id"] for e in req} == {"7"}
+        names = [e["name"] for e in req if e["ph"] == "b"]
+        assert names == [
+            "request", "queue_wait", "batch_fill", "dispatch", "scatter"
+        ]
+        # counter track with the sample value
+        depth = [e for e in evs
+                 if e["ph"] == "C" and e["name"] == "depth"]
+        assert depth and depth[0]["args"]["value"] == 3.0
+        assert doc["otherData"]["spans_dropped"] == 0
+        assert doc["otherData"]["events_dropped"] == 0
+
+    def test_partial_request_renders_root_only(self, telemetry, tmp_path):
+        trace.request({
+            "id": 9, "outcome": "expired",
+            "submit_ts": 5.0, "done_ts": 5.5,
+        })
+        path = str(tmp_path / "t.json")
+        obs.write_chrome_trace(path)
+        doc = json.load(open(path))
+        req = [e for e in doc["traceEvents"]
+               if e.get("cat") == "serve.request"]
+        assert [e["name"] for e in req] == ["request", "request"]
+        assert req[0]["args"]["outcome"] == "expired"
+
+    def test_metrics_become_counter_tracks(self, telemetry, tmp_path):
+        obs.REGISTRY.counter("my_total").inc(4)
+        obs.REGISTRY.gauge("my_gauge").set(0.5)
+        path = str(tmp_path / "t.json")
+        obs.write_chrome_trace(path)
+        doc = json.load(open(path))
+        tracks = {e["name"]: e["args"]["value"]
+                  for e in doc["traceEvents"] if e["ph"] == "C"}
+        assert tracks["my_total"] == 4.0
+        assert tracks["my_gauge"] == 0.5
+
+    def test_validator_rejects_schema_violations(self, tmp_path):
+        def write(doc):
+            p = str(tmp_path / "bad.json")
+            with open(p, "w") as f:
+                json.dump(doc, f)
+            return p
+
+        with pytest.raises(ValueError, match="not JSON"):
+            p = str(tmp_path / "bad.json")
+            open(p, "w").write("{nope")
+            trace.validate_chrome_trace(p)
+        with pytest.raises(ValueError, match="traceEvents missing"):
+            trace.validate_chrome_trace(write({"foo": 1}))
+        with pytest.raises(ValueError, match="empty traceEvents"):
+            trace.validate_chrome_trace(write({"traceEvents": []}))
+        with pytest.raises(ValueError, match="unknown phase"):
+            trace.validate_chrome_trace(
+                write({"traceEvents": [{"ph": "Z", "pid": 1}]}))
+        with pytest.raises(ValueError, match="missing numeric ts"):
+            trace.validate_chrome_trace(
+                write({"traceEvents": [{"ph": "i", "pid": 1}]}))
+        with pytest.raises(ValueError, match="counter without numeric"):
+            trace.validate_chrome_trace(write({
+                "traceEvents": [
+                    {"ph": "C", "pid": 1, "ts": 0.0, "args": {}}
+                ]
+            }))
+        with pytest.raises(ValueError, match="without id/cat"):
+            trace.validate_chrome_trace(write({
+                "traceEvents": [{"ph": "b", "pid": 1, "ts": 0.0}]
+            }))
+
+
+# --------------------------------------------------------------------------
+# request-scoped serving traces
+# --------------------------------------------------------------------------
+
+
+class TestRequestTracing:
+    def test_served_requests_carry_monotonic_segment_tree(
+        self, telemetry, rng
+    ):
+        from photon_tpu.serve.queue import MicroBatchQueue
+
+        programs = _programs(rng)
+        with MicroBatchQueue(programs, max_linger_s=0.001) as q:
+            futs = [q.submit(*_request(rng, str(i % E)))
+                    for i in range(6)]
+            for f in futs:
+                f.result(timeout=30)
+        recs = trace.request_records()
+        assert len(recs) == 6
+        assert {r["outcome"] for r in recs} == {"served"}
+        assert len({r["id"] for r in recs}) == 6
+        for r in recs:
+            assert (r["submit_ts"] <= r["take_ts"] <= r["dispatch_ts"]
+                    <= r["scatter_ts"] <= r["done_ts"])
+            assert r["batch_size"] >= 1
+        summary = trace.request_summary()
+        assert summary["outcomes"] == {"served": 6}
+        assert set(summary["segment_mean_ms"]) == {
+            "queue_wait", "batch_fill", "dispatch", "scatter"
+        }
+
+    def test_expired_and_closed_outcomes_recorded(self, telemetry, rng):
+        from photon_tpu.resilience.errors import DeadlineExceededError
+        from photon_tpu.serve.queue import MicroBatchQueue, QueueClosed
+
+        programs = _programs(rng)
+        q = MicroBatchQueue(programs, max_batch=4, max_linger_s=0.2)
+        # already past its deadline at submit: fails fast pre-dispatch
+        fut = q.submit(*_request(rng), deadline_s=0.0)
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=30)
+        q.close()
+        with pytest.raises(QueueClosed):
+            q.submit(*_request(rng))
+        outcomes = [r["outcome"] for r in trace.request_records()]
+        assert outcomes.count("expired") == 1
+        assert outcomes.count("closed") == 1
+
+    def test_shed_outcome_recorded(self, telemetry, rng):
+        from photon_tpu.resilience.errors import OverloadedError
+        from photon_tpu.serve.queue import MicroBatchQueue
+
+        programs = _programs(rng)
+        with MicroBatchQueue(
+            programs, max_batch=4, max_linger_s=0.3, shed_watermark=1
+        ) as q:
+            first = q.submit(*_request(rng))
+            # first lingers in the pending deque -> depth is at the
+            # watermark -> the second submit sheds instead of queueing
+            with pytest.raises(OverloadedError):
+                q.submit(*_request(rng))
+            first.result(timeout=30)
+        recs = trace.request_records()
+        by_outcome = {r["outcome"] for r in recs}
+        assert {"served", "shed"} == by_outcome
+
+    def test_dispatch_error_outcome_recorded(self, telemetry, rng):
+        from photon_tpu.serve.queue import MicroBatchQueue
+
+        class Boom:
+            class ladder:
+                max_batch = 4
+                rungs = (4,)
+
+            tables = None
+
+            def pack_requests(self, reqs):
+                raise ValueError("boom")
+
+        q = MicroBatchQueue(Boom(), max_linger_s=0.001)
+        fut = q.submit({"features": np.zeros(1, np.float32)}, {})
+        with pytest.raises(ValueError, match="boom"):
+            fut.result(timeout=30)
+        q.close()
+        recs = trace.request_records()
+        assert [r["outcome"] for r in recs] == ["error"]
+        assert recs[0]["error"] == "ValueError"
+
+    def test_request_jsonl_round_trip_validates(
+        self, telemetry, rng, tmp_path
+    ):
+        from photon_tpu.serve.queue import MicroBatchQueue
+
+        programs = _programs(rng)
+        with MicroBatchQueue(programs, max_linger_s=0.001) as q:
+            futs = [q.submit(*_request(rng, str(i % E)))
+                    for i in range(4)]
+            for f in futs:
+                f.result(timeout=30)
+        path = str(tmp_path / "requests.jsonl")
+        n = obs.trace.write_request_jsonl(path)
+        assert n == 5  # header + 4 records
+        assert obs.validate_jsonl(path) == 5
+
+    def test_validate_jsonl_rejects_unknown_outcome(self, tmp_path):
+        path = str(tmp_path / "bad.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"type": "telemetry", "version": 1}) + "\n")
+            f.write(json.dumps({
+                "type": "request", "id": 1, "outcome": "vanished",
+                "submit_ts": 0.0, "done_ts": 1.0,
+            }) + "\n")
+        with pytest.raises(ValueError, match="unknown request outcome"):
+            obs.validate_jsonl(path)
+
+    def test_driver_reports_request_trace(self, telemetry, rng):
+        from photon_tpu.serve.driver import drive, synthetic_requests
+        from photon_tpu.serve.queue import MicroBatchQueue
+        from photon_tpu.serve.tables import CoefficientTables
+
+        programs = _programs(rng)
+        tables = programs.tables
+        requests = synthetic_requests(tables, programs, 24, seed=3)
+        with MicroBatchQueue(programs, max_linger_s=0.001) as q:
+            out = drive(q, requests, warmup=4)
+        assert out["request_trace"]["outcomes"]["served"] == 24
+        assert "queue_wait" in out["request_trace"]["segment_mean_ms"]
+
+
+# --------------------------------------------------------------------------
+# the flight recorder
+# --------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_dump_payload_sections(self, telemetry, tmp_path):
+        # retry stats are process-global and always-on: earlier suites'
+        # injected transients would leak into the zero assertion below.
+        reset_retry_stats()
+        rec = flight.install(str(tmp_path), signals=False)
+        try:
+            with obs.span("doomed_section"):
+                trace.instant("last_words", cat="test")
+            obs.REGISTRY.counter("moved_total").inc(3)
+            path = rec.dump("test")
+            assert path and os.path.exists(path)
+            payload = json.load(open(path))
+            assert payload["reason"] == "test"
+            assert payload["pid"] == os.getpid()
+            assert any(s["name"] == "doomed_section"
+                       for s in payload["spans"])
+            assert any(e.get("name") == "last_words"
+                       for e in payload["events"])
+            assert payload["counter_deltas"]["moved_total"] == 3.0
+            assert payload["retry_stats"]["retries"] == 0
+        finally:
+            flight.uninstall()
+
+    def test_reinstall_hands_back_a_replaced_recorder(self, tmp_path):
+        """The CLI nesting contract: a default-on CLI install replaces
+        an ambient recorder; uninstall + reinstall hands it back with
+        its hooks re-chained and its identity (baseline, directory)
+        intact."""
+        import sys
+
+        ambient = flight.install(str(tmp_path / "ambient"), signals=False)
+        try:
+            inner = flight.install(str(tmp_path / "cli"), signals=False)
+            assert flight.installed() is inner
+            flight.uninstall()
+            assert flight.installed() is None
+            back = flight.reinstall(ambient)
+            assert back is ambient
+            assert flight.installed() is ambient
+            assert sys.excepthook == ambient._on_exception
+            assert obs.enabled()  # reinstall re-arms recording
+            path = flight.dump("handback")
+            assert path and str(tmp_path / "ambient") in path
+        finally:
+            flight.uninstall()
+            obs.reset()
+            obs.disable()
+
+    def test_install_enables_telemetry_uninstall_restores(self, tmp_path):
+        was = obs.enabled()
+        obs.disable()
+        try:
+            flight.install(str(tmp_path), signals=False)
+            assert obs.enabled()  # a recorder with empty rings is useless
+            flight.uninstall()
+            assert not obs.enabled()
+        finally:
+            obs.TRACER.enabled = was
+            obs.reset()
+
+    def test_dump_on_crash_fault(self, telemetry, tmp_path):
+        flight.install(str(tmp_path), signals=False)
+        try:
+            plan = FaultPlan(
+                [dict(point="fit.dispatch", nth=1, error="crash")]
+            )
+            with faults.injected(plan):
+                with pytest.raises(InjectedCrash):
+                    faults.check("fit.dispatch")
+        finally:
+            flight.uninstall()
+        dumps = glob.glob(str(tmp_path / "flight-*.json"))
+        assert len(dumps) == 1
+        payload = json.load(open(dumps[0]))
+        assert payload["reason"] == "fault.crash:fit.dispatch"
+        # the fired fault itself is on the dumped timeline
+        assert any(e.get("name") == "fault.fired"
+                   for e in payload["events"])
+
+    def test_excepthook_chains_and_dumps(self, telemetry, tmp_path):
+        seen = []
+        prev = sys.excepthook
+        sys.excepthook = lambda *a: seen.append(a)
+        try:
+            flight.install(str(tmp_path), signals=False)
+            try:
+                sys.excepthook(ValueError, ValueError("die"), None)
+            finally:
+                flight.uninstall()
+            assert sys.excepthook is not prev  # our spy is restored
+            assert len(seen) == 1  # the chained previous hook ran
+        finally:
+            sys.excepthook = prev
+        dumps = glob.glob(str(tmp_path / "flight-*.json"))
+        assert len(dumps) == 1
+        assert json.load(open(dumps[0]))["reason"] == \
+            "exception:ValueError"
+
+    def test_failed_dump_never_raises(self, telemetry, tmp_path):
+        bad = tmp_path / "not-a-dir"
+        bad.write_text("file, not dir")
+        rec = flight.install(str(bad), signals=False)
+        try:
+            assert rec.dump("test") is None  # logs, returns None
+        finally:
+            flight.uninstall()
+
+    def test_module_dump_without_recorder_is_noop(self):
+        flight.uninstall()
+        assert flight.dump("whatever") is None
+
+    def test_sigterm_subprocess_leaves_flight_dump(self, tmp_path):
+        """The PR-7 real-subprocess pattern: `photon train` held mid-fit
+        by an injected delay receives SIGTERM; alongside the emergency
+        checkpoint, the default-on flight recorder leaves
+        flight-<pid>.json in the output dir with the signal reason."""
+        from photon_tpu.resilience import load_training_checkpoint
+        from test_resilience import _write_cli_workload
+
+        cfg_path = _write_cli_workload(tmp_path, num_iterations=3)
+        ckpt_dir = tmp_path / "ckpt"
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": str(REPO_ROOT),
+            faults.ENV_VAR: json.dumps({"faults": [{
+                "point": "cd.iteration", "nth": 1,
+                "error": "delay", "seconds": 120,
+            }]}),
+        })
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "photon_tpu.cli.train",
+                "--config", str(cfg_path),
+                "--checkpoint-dir", str(ckpt_dir),
+                "--flight-dir", str(tmp_path / "flight"),
+            ],
+            cwd=str(REPO_ROOT), env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        try:
+            manifest = ckpt_dir / "manifest.json"
+            deadline = time.time() + 120
+            while not manifest.exists() and time.time() < deadline:
+                assert proc.poll() is None, (
+                    proc.communicate()[1].decode()
+                )
+                time.sleep(0.2)
+            assert manifest.exists(), "no checkpoint within 120s"
+            time.sleep(0.5)
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        assert proc.returncode == 128 + signal.SIGTERM, err.decode()
+        # recovery point AND post-mortem committed together
+        assert load_training_checkpoint(str(ckpt_dir)).interrupted
+        dumps = glob.glob(str(tmp_path / "flight" / "flight-*.json"))
+        assert len(dumps) == 1, err.decode()
+        payload = json.load(open(dumps[0]))
+        assert payload["reason"] == f"signal:{signal.SIGTERM}"
+        assert payload["pid"] == proc.pid
+
+
+# --------------------------------------------------------------------------
+# the profiler entry point
+# --------------------------------------------------------------------------
+
+
+class TestProfileSession:
+    def test_wraps_profiler_inside_correlated_span(
+        self, telemetry, monkeypatch
+    ):
+        import jax
+
+        calls = []
+
+        @contextlib.contextmanager
+        def fake_trace(trace_dir):
+            calls.append(trace_dir)
+            yield
+
+        monkeypatch.setattr(jax.profiler, "trace", fake_trace)
+        with trace.profile_session("/tmp/photon-prof", name="prof"):
+            pass
+        assert calls == ["/tmp/photon-prof"]
+        spans = [s.name for s in obs.TRACER.completed()]
+        assert "prof" in spans
+        names = [e["name"] for e in trace.events()
+                 if e["kind"] == "instant"]
+        assert names == ["profile.start", "profile.stop"]
+
+    def test_falsy_dir_is_noop(self, telemetry):
+        with trace.profile_session(None):
+            pass
+        with trace.profile_session(""):
+            pass
+        assert trace.events() == []
+        assert obs.TRACER.completed() == []
+
+    def test_deprecated_shim_routes_here(self, telemetry, monkeypatch):
+        import jax
+
+        from photon_tpu.utils import profile_trace
+
+        calls = []
+
+        @contextlib.contextmanager
+        def fake_trace(trace_dir):
+            calls.append(trace_dir)
+            yield
+
+        monkeypatch.setattr(jax.profiler, "trace", fake_trace)
+        with pytest.warns(DeprecationWarning, match="profile_session"):
+            with profile_trace("/tmp/photon-prof"):
+                pass
+        assert calls == ["/tmp/photon-prof"]
+        # the shim inherits the correlation contract
+        assert any(s.name == "jax_profiler"
+                   for s in obs.TRACER.completed())
+
+
+# --------------------------------------------------------------------------
+# the roofline gate
+# --------------------------------------------------------------------------
+
+
+class TestRooflineGate:
+    def _bench(self):
+        if str(REPO_ROOT) not in sys.path:
+            sys.path.insert(0, str(REPO_ROOT))
+        import bench
+
+        return bench
+
+    def test_floor_trips_on_slowed_fixture(self):
+        bench = self._bench()
+        ceiling = bench.FLOORS["logistic_measured_vs_roofline_max"]
+        # a deliberately slowed fit: twice the allowed distance from
+        # the roofline must fail the bench
+        slow = {"measured_vs_roofline": ceiling * 2}
+        out = bench.roofline_regressions("logistic", slow)
+        assert len(out) == 1
+        assert "measured_vs_roofline" in out[0]
+
+    def test_floor_passes_at_or_under_ceiling(self):
+        bench = self._bench()
+        ceiling = bench.FLOORS["logistic_measured_vs_roofline_max"]
+        assert bench.roofline_regressions(
+            "logistic", {"measured_vs_roofline": ceiling}) == []
+        # skipped/errored cost model never false-positives the gate
+        assert bench.roofline_regressions(
+            "logistic", {"skipped": "mesh path"}) == []
+        assert bench.roofline_regressions("logistic", {}) == []
+
+    def test_ungated_variant_reports_without_gating(self):
+        bench = self._bench()
+        assert bench.roofline_regressions(
+            "linear", {"measured_vs_roofline": 10_000.0}) == []
+
+
+# --------------------------------------------------------------------------
+# contracts
+# --------------------------------------------------------------------------
+
+
+def test_trace_contract_registered():
+    from photon_tpu.analysis import program
+
+    contracts = {c.name: c for c in program.collect_contracts()}
+    assert "trace" in contracts
+    assert contracts["trace"].hot_loop
+    assert "trace_toggle" in contracts["trace"].stable_under
