@@ -671,6 +671,7 @@ def run_serving() -> dict:
     window must be ZERO (`serving_compile_events`; the static half is the
     tier-2 `serving` contract). A violation lands in `regressions`.
     """
+    from photon_tpu.obs.monitor import SloPolicy
     from photon_tpu.serve.driver import drive, synthetic_requests
     from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
     from photon_tpu.serve.queue import MicroBatchQueue
@@ -688,7 +689,17 @@ def run_serving() -> dict:
     )
     before = compile_event_count()
     with MicroBatchQueue(
-        programs, max_linger_s=SERVE_MAX_LINGER_MS / 1e3
+        programs, max_linger_s=SERVE_MAX_LINGER_MS / 1e3,
+        # Declared SLOs (obs/monitor.py): the error budget is the
+        # gated one — a clean bench must burn ZERO of it
+        # (serving_regressions). The latency target is generous by
+        # design: this drive floods to saturation, so its p99 measures
+        # queueing depth, not service latency, and a tight target here
+        # would gate the box's load, not the code.
+        slo=SloPolicy(
+            p99_ms=10_000.0, error_rate=0.001, cold_entity_rate=0.2,
+            short_window_s=2.0, long_window_s=24.0,
+        ),
     ) as queue:
         summary = drive(queue, requests)
         health = queue.health()
@@ -702,6 +713,16 @@ def run_serving() -> dict:
         "serving_batch_fill_fraction": summary["batch_fill_fraction"],
         "serving_mean_batch_size": summary["mean_batch_size"],
         "serving_cold_entity_rate": summary["cold_entity_rate"],
+        # Live-monitoring block (PR 9, obs/monitor.py): per-coordinate
+        # cold rates (the aggregate above stays for compatibility),
+        # sliding-window p50/p99 next to the whole-run percentiles,
+        # the SLO burn report, and the hotness sketches' top entities.
+        "serving_cold_entity_rate_by_coordinate": summary[
+            "cold_entity_rate_by_coordinate"
+        ],
+        "serving_window_latency": summary["window_latency"],
+        "serving_slo": summary.get("slo"),
+        "serving_hot_entities": summary["hot_entities"],
         "serving_batches": summary["batches"],
         "serving_errors": summary["errors"],
         "serving_rungs": list(programs.ladder.rungs),
@@ -772,6 +793,17 @@ def serving_regressions(serving: dict) -> list[str]:
                 f"clean serving run recorded {health[key]} "
                 f"{key} event(s) (degraded-mode counters must be zero "
                 "without injected faults)")
+    # SLO burn gate (obs/monitor.py): with no injected faults, the
+    # ERROR budget must burn zero — any error burn on a clean run is a
+    # real failure the counters above would have caught, now phrased
+    # as the SLO the serving fleet would page on.
+    err = ((serving.get("serving_slo") or {}).get("error_rate")) or {}
+    if err.get("burn_short", 0) or err.get("burn_long", 0):
+        out.append(
+            "clean serving run burned error-rate SLO budget "
+            f"(burn short={err.get('burn_short')} "
+            f"long={err.get('burn_long')}; must be zero without "
+            "injected faults)")
     return out
 
 
@@ -1133,6 +1165,20 @@ def run_smoke() -> dict:
     for key in ("serving_p50_ms", "serving_p99_ms", "serving_qps"):
         if serving.get(key) is None:
             regressions.append(f"serving scenario missing {key}")
+    # Live-monitoring surfaces must ENGAGE on the CI workload (their
+    # values are judged at TPU scale; a dead surface is the smoke
+    # regression, same policy as the roofline gauge above).
+    if not serving.get("serving_slo"):
+        regressions.append(
+            "serving scenario missing serving_slo (SLO tracker dead)")
+    if not (serving.get("serving_window_latency") or {}).get("count"):
+        regressions.append(
+            "sliding latency window recorded nothing (window ring dead)")
+    if not any(
+        (serving.get("serving_hot_entities") or {}).values()
+    ):
+        regressions.append(
+            "hotness sketches recorded no entities (sketch feed dead)")
     telemetry = obs.snapshot()
     if not telemetry["spans"]:
         regressions.append("telemetry recorded no spans")

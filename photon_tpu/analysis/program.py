@@ -1102,6 +1102,174 @@ def build_trace() -> ContractTrace:
     )
 
 
+def build_monitor() -> ContractTrace:
+    """The live-monitoring layer's audited zero-overhead guarantee.
+
+    The serving score program (the request hot path the exporter
+    observes) is traced with everything OFF (base), then with the
+    monitor layer FULLY ARMED AND UNDER LOAD: a ``MonitorServer`` up on
+    an ephemeral port with the window-histogram/SLO/hotness collectors
+    registered, a feeder thread pumping observations into the window
+    ring, the sketch, and the SLO tracker the whole time, and real
+    HTTP scrapes of ``/metrics`` + ``/healthz`` + ``/readyz`` issued
+    before, during, and after the armed trace. The ``monitor_scrape``
+    variant must be byte-identical to the base with zero added
+    programs — a scrape is host bookkeeping and socket I/O, never a
+    traced operand or a callback — and every scraped ``/metrics`` body
+    must validate as Prometheus text exposition
+    (``monitor.validate_exposition``).
+    """
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.obs import monitor
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+    from photon_tpu.types import TaskType
+
+    d, e, s, du = 4, 5, 2, 4
+    rng = np.random.default_rng(20260803)
+    proj = np.stack([
+        np.sort(rng.permutation(du)[:s]) for _ in range(e)
+    ]).astype(np.int64)
+    model = GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(means=jnp.asarray(
+                    rng.normal(size=d).astype(np.float32)
+                )),
+                TaskType.LOGISTIC_REGRESSION,
+            ),
+            "features",
+        ),
+        "per-user": RandomEffectModel(
+            coefficients=jnp.asarray(
+                rng.normal(size=(e, s)).astype(np.float32)
+            ),
+            random_effect_type="userId",
+            feature_shard_id="userShard",
+            task=TaskType.LOGISTIC_REGRESSION,
+            proj_all=proj,
+            entity_keys=tuple(str(i) for i in range(e)),
+        ),
+    })
+    tables = CoefficientTables.from_game_model(model)
+    programs = ScorePrograms(
+        tables, ladder=ShapeLadder((8,)), compile_now=False
+    )
+
+    def trace_once() -> TracedProgram:
+        traced = programs.trace(8)
+        return TracedProgram(
+            name="score_b8",
+            text=str(traced.jaxpr),
+            jaxpr=traced.jaxpr,
+            lowered=traced.lower(),
+        )
+
+    base = trace_once()
+
+    hist = monitor.RollingHistogram(window_s=0.5, num_windows=4)
+    sketch = monitor.SpaceSavingSketch(8)
+    slo = monitor.SloTracker(
+        monitor.SloPolicy(short_window_s=0.5, long_window_s=2.0)
+    )
+
+    def collect():
+        return (
+            [hist.prometheus_family(
+                "audit_latency_window_seconds", "audit window ring")]
+            + slo.prometheus_families()
+        )
+
+    stop = threading.Event()
+
+    def feeder():
+        import time
+
+        i = 0
+        while not stop.is_set():
+            hist.observe(0.001 * (1 + i % 7))
+            sketch.observe(f"entity-{i % 11}")
+            slo.observe_request(0.002)
+            slo.observe_lookups(4, 1)
+            i += 1
+            # Keep the surfaces hot without pegging a CI core: the
+            # audit needs concurrent writers, not maximum write rate.
+            time.sleep(0.0005)
+
+    notes: list[str] = []
+    srv = monitor.MonitorServer(0, readiness=lambda: (True, {}),
+                                collectors=[collect]).start()
+    thread = threading.Thread(target=feeder, daemon=True)  # photon: ignore[concurrency-contract] -- audit-fixture load generator, joined before the builder returns; the shared surfaces it feeds carry their own obs-monitor contract
+    thread.start()
+
+    def scrape() -> None:
+        for path in ("/metrics", "/healthz", "/readyz"):
+            body = urllib.request.urlopen(
+                srv.url + path, timeout=5
+            ).read().decode("utf-8")
+            if path == "/metrics":
+                monitor.validate_exposition(body)
+
+    # A second scraper loops CONCURRENTLY with the armed trace below —
+    # "during" is exercised for real, not just claimed. Its failures
+    # are collected and re-raised as a builder error (-> a
+    # program-contract finding), never swallowed.
+    scrape_errors: list[BaseException] = []
+    during_scrapes = [0]
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                scrape()
+                during_scrapes[0] += 1
+            except BaseException as exc:  # noqa: BLE001 — surfaced below
+                scrape_errors.append(exc)
+                return
+
+    scraper_thread = threading.Thread(target=scraper, daemon=True)  # photon: ignore[concurrency-contract] -- audit-fixture scraper, joined before the builder returns; see the feeder waiver above
+    try:
+        scrape()
+        scraper_thread.start()
+        armed = TracedProgram(
+            name="score_b8", text=str(programs.trace(8).jaxpr)
+        )
+        stop.set()
+        scraper_thread.join(timeout=10.0)
+        scrape()
+        if scrape_errors:
+            raise scrape_errors[0]
+        notes.append(
+            f"exporter scraped before, DURING ({during_scrapes[0]} "
+            "concurrent scrape round(s)), and after the armed trace; "
+            "every /metrics body validated as text exposition; the "
+            "window ring, hotness sketch, and SLO tracker were fed "
+            "from a second thread throughout"
+        )
+    finally:
+        stop.set()
+        thread.join(timeout=5.0)
+        if scraper_thread.is_alive():  # pragma: no cover — start() raced
+            scraper_thread.join(timeout=5.0)
+        srv.stop()
+    return ContractTrace(
+        programs={"score_b8": base},
+        variants={"monitor_scrape": [{"score_b8": armed.signature}]},
+        notes=notes,
+    )
+
+
 def build_serving() -> ContractTrace:
     """The serving score ladder's zero-recompile contract.
 
@@ -1368,6 +1536,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_ingest_pipeline": build_ingest_pipeline,
     "build_telemetry": build_telemetry,
     "build_trace": build_trace,
+    "build_monitor": build_monitor,
     "build_serving": build_serving,
     "build_resilience": build_resilience,
     "build_evaluators": build_evaluators,

@@ -1,0 +1,202 @@
+"""``photon benchtrend``: gate the bench HISTORY, not just static floors.
+
+Five rounds of ``BENCH_r*.json`` sat unread while CI compared each run
+only against frozen floors — a slow drift (or a one-round cliff like the
+round-4 11x compile regression, which the floors of the day let through)
+is invisible to a static threshold but obvious in the series. This tool
+reads the whole ``BENCH_r*.json`` history, prints a per-metric trend
+table, and exits nonzero when the LATEST round regresses beyond a
+declared tolerance against the TRAILING BEST (the best value any prior
+round achieved) — run it in CI after the bench smoke so history finally
+gates.
+
+Rules:
+
+- A tracked metric absent from every round is skipped (the serving
+  block only exists from round 6 on; old history must not fail).
+- No prior round carrying the metric means nothing to gate (a newly
+  added metric starts its history).
+- A metric present in the PREVIOUS round but missing from the latest is
+  a regression in itself — a silently dead gauge is how tracked metrics
+  rot.
+- Otherwise: ``higher``-is-better metrics regress when
+  ``latest < best_prior / tolerance``; ``lower``-is-better when
+  ``latest > best_prior * tolerance``. The default tolerance (1.5x)
+  matches the bench FLOORS ratchet policy: loose enough for the noisy
+  2-core CI box, tight enough that the round-4 compile cliff (11x)
+  would have failed the round it happened.
+
+Usage:
+    python -m photon_tpu.cli.benchtrend [--dir .] [--json PATH]
+    python tools/bench_trend.py            # same tool, script entry
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# metric -> (direction, tolerance, fallback keys tried in order after
+# the primary). Directions: "higher" / "lower" is better.
+TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
+    "logistic_rows_per_sec": ("higher", 1.5, ()),
+    "linear_rows_per_sec": ("higher", 1.5, ()),
+    "logistic_ingest_rows_per_sec_best": (
+        "higher", 1.5, ("logistic_ingest_rows_per_sec",)
+    ),
+    "logistic_compile_seconds": ("lower", 1.5, ()),
+    "logistic_e2e_seconds": ("lower", 1.5, ()),
+    "logistic_warm_cache_e2e_seconds": ("lower", 1.5, ()),
+    "logistic_measured_vs_roofline": ("lower", 1.5, ()),
+    "serving_p99_ms": ("lower", 1.5, ()),
+    "serving_qps": ("higher", 1.5, ()),
+}
+
+
+def load_round(path: str) -> dict | None:
+    """One round's bench line. Round-capture files wrap the line under
+    ``parsed`` (next to cmd/rc/tail); a raw bench output line is taken
+    as-is. Unparseable files are reported as None, never a crash — a
+    corrupt capture must not take the trend gate down with it."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc if isinstance(doc, dict) else None
+
+
+def metric_value(parsed: dict, name: str) -> float | None:
+    _, _, fallbacks = TRACKED[name]
+    for key in (name, *fallbacks):
+        v = parsed.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+    return None
+
+
+def analyze(rounds: list[tuple[str, dict]]) -> dict:
+    """Trend rows + regressions for an ordered (label, parsed) series."""
+    out: dict = {"rounds": [label for label, _ in rounds], "metrics": {},
+                 "regressions": []}
+    if not rounds:
+        out["regressions"].append("no bench history found")
+        return out
+    latest_label = rounds[-1][0]
+    for name, (direction, tol, _) in TRACKED.items():
+        series = [metric_value(parsed, name) for _, parsed in rounds]
+        if all(v is None for v in series):
+            continue
+        prior = [v for v in series[:-1] if v is not None]
+        latest = series[-1]
+        best_prior = (
+            None if not prior
+            else (max(prior) if direction == "higher" else min(prior))
+        )
+        status = "ok"
+        if latest is None:
+            if series[:-1] and series[-2] is not None:
+                status = "missing"
+                out["regressions"].append(
+                    f"{name}: tracked metric present in the previous "
+                    f"round but missing from {latest_label} (dead gauge)"
+                )
+            else:
+                status = "n/a"
+        elif best_prior is None:
+            status = "new"
+        elif direction == "higher" and latest < best_prior / tol:
+            status = "REGRESSED"
+            out["regressions"].append(
+                f"{name}: {latest:g} < trailing best {best_prior:g} "
+                f"/ {tol:g} (higher is better)"
+            )
+        elif direction == "lower" and latest > best_prior * tol:
+            status = "REGRESSED"
+            out["regressions"].append(
+                f"{name}: {latest:g} > trailing best {best_prior:g} "
+                f"x {tol:g} (lower is better)"
+            )
+        out["metrics"][name] = {
+            "direction": direction,
+            "tolerance": tol,
+            "series": series,
+            "trailing_best": best_prior,
+            "latest": latest,
+            "status": status,
+        }
+    return out
+
+
+def render_table(report: dict) -> str:
+    labels = report["rounds"]
+    head = ["metric", "dir", *labels, "best<", "status"]
+    rows = [head]
+    for name, m in report["metrics"].items():
+        rows.append([
+            name,
+            m["direction"][0] + "^" if m["direction"] == "higher"
+            else m["direction"][0] + "v",
+            *[
+                "-" if v is None else f"{v:g}" for v in m["series"]
+            ],
+            "-" if m["trailing_best"] is None
+            else f"{m['trailing_best']:g}",
+            m["status"],
+        ])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(head))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in rows
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon benchtrend", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--dir", default=".",
+                        help="directory holding the BENCH_r*.json series")
+    parser.add_argument("--pattern", default="BENCH_r*.json",
+                        help="history glob (lexicographic order = "
+                             "round order)")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="also write the machine-readable trend "
+                             "report to PATH")
+    args = parser.parse_args(argv)
+
+    paths = sorted(glob.glob(os.path.join(args.dir, args.pattern)))
+    rounds: list[tuple[str, dict]] = []
+    skipped: list[str] = []
+    for p in paths:
+        parsed = load_round(p)
+        label = os.path.splitext(os.path.basename(p))[0].replace(
+            "BENCH_", ""
+        )
+        if parsed is None:
+            skipped.append(label)
+            continue
+        rounds.append((label, parsed))
+
+    report = analyze(rounds)
+    if skipped:
+        report["skipped_unparseable"] = skipped
+    print(render_table(report))
+    for reg in report["regressions"]:
+        print(f"REGRESSION: {reg}")
+    if not report["regressions"]:
+        print(f"trend OK across {len(rounds)} round(s)")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

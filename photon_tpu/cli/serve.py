@@ -66,6 +66,28 @@ def main(argv=None) -> int:
     parser.add_argument("--target-qps", type=float, default=None,
                         help="pace submissions at this offered load "
                              "(default: flood — closed-loop saturation)")
+    parser.add_argument("--monitor-port", type=int, default=None,
+                        metavar="PORT",
+                        help="serve /metrics (Prometheus text "
+                             "exposition), /healthz, and /readyz on "
+                             "this port for the whole run (0 = "
+                             "ephemeral; the bound port rides the "
+                             "output JSON). /readyz flips 200 once "
+                             "tables are loaded, the AOT ladder is "
+                             "compiled, and the breaker is closed "
+                             "(OBSERVABILITY.md §live monitoring)")
+    parser.add_argument("--slo-p99-ms", type=float, default=250.0,
+                        help="latency SLO: 99%% of served requests "
+                             "must finish under this many ms")
+    parser.add_argument("--slo-error-rate", type=float, default=0.001,
+                        help="error-rate SLO budget (fraction of "
+                             "requests allowed to fail)")
+    parser.add_argument("--slo-cold-rate", type=float, default=0.2,
+                        help="cold-entity-rate SLO budget (fraction "
+                             "of lookups allowed out-of-vocabulary)")
+    parser.add_argument("--slo-window-s", type=float, default=5.0,
+                        help="short burn-rate window, seconds (the "
+                             "long window is 12x)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--id-tags", nargs="*", default=None)
     parser.add_argument("--json", default=None, metavar="PATH",
@@ -159,7 +181,48 @@ def _run(args) -> int:
 
 
 def _run_instrumented(args, obs, compile_event_count) -> int:
-    from photon_tpu.obs import logged_span
+    from photon_tpu.obs import monitor
+
+    # Live monitoring (obs/monitor.py): the exporter comes up BEFORE
+    # the model loads so /healthz answers from the first second of the
+    # process, while /readyz stays 503 until tables are resident, the
+    # AOT ladder is compiled, AND the breaker is closed — the
+    # load-balancer handshake a resident scorer needs. The queue's
+    # metrics collector is registered once the queue exists.
+    ready_state = {"tables_loaded": False, "ladder_compiled": False}
+    queue_ref: list = []
+
+    def _readiness():
+        breaker_open = bool(
+            queue_ref and queue_ref[0].health()["breaker_open"]
+        )
+        ready = (
+            ready_state["tables_loaded"]
+            and ready_state["ladder_compiled"]
+            and bool(queue_ref)
+            and not breaker_open
+        )
+        return ready, {**ready_state, "queue_up": bool(queue_ref),
+                       "breaker_open": breaker_open}
+
+    mon = None
+    if args.monitor_port is not None:
+        mon = monitor.MonitorServer(
+            args.monitor_port, readiness=_readiness
+        ).start()
+    try:
+        return _serve_instrumented(
+            args, obs, compile_event_count, mon, ready_state, queue_ref
+        )
+    finally:
+        if mon is not None:
+            mon.stop()
+
+
+def _serve_instrumented(
+    args, obs, compile_event_count, mon, ready_state, queue_ref
+) -> int:
+    from photon_tpu.obs import logged_span, monitor
     from photon_tpu.serve.driver import (
         dataset_requests,
         drive,
@@ -221,12 +284,14 @@ def _run_instrumented(args, obs, compile_event_count) -> int:
             model, _ = load_game_model(args.model_dir, index_maps)
 
     tables = CoefficientTables.from_game_model(model)
+    ready_state["tables_loaded"] = True
     with logged_span("serve: AOT-compile score ladder"):
         programs = ScorePrograms(
             tables,
             ladder=ladder,
             specs=specs_from_dataset(data) if data is not None else None,
         )
+    ready_state["ladder_compiled"] = True
 
     if data is not None:
         requests = dataset_requests(data, programs)
@@ -252,7 +317,20 @@ def _run_instrumented(args, obs, compile_event_count) -> int:
             ),
             shed_watermark=args.shed_watermark,
             breaker_threshold=args.breaker_threshold or None,
+            slo=monitor.SloPolicy(
+                p99_ms=args.slo_p99_ms,
+                error_rate=args.slo_error_rate,
+                cold_entity_rate=args.slo_cold_rate,
+                short_window_s=args.slo_window_s,
+                long_window_s=12 * args.slo_window_s,
+            ),
         ) as queue:
+            queue_ref.append(queue)
+            if mon is not None:
+                # From here /readyz is 200 and /metrics carries the
+                # queue collector (depth, per-coordinate cold, window
+                # quantiles, hotness, SLO burn).
+                mon.add_collector(queue.metrics_families)
             summary = drive(queue, requests, rate=args.target_qps)
             health = queue.health()
     after = compile_event_count()
@@ -270,9 +348,13 @@ def _run_instrumented(args, obs, compile_event_count) -> int:
         "dispatches": programs.stats["dispatches"],
         "compile_events_during_serving": after - before,
         # Degraded-mode snapshot (queue depth, shed/deadline/breaker/
-        # retry counters, table generation) — what a health probe reads.
+        # retry counters, table generation, window quantiles, SLO burn)
+        # — what a health probe reads.
         "health": health,
+        "tables": tables.coordinate_stats(),
     }
+    if mon is not None:
+        out["monitor"] = {"port": mon.port, **mon.scrape_stats()}
     out.update(summary)
     if args.telemetry:
         obs.write_jsonl(args.telemetry)

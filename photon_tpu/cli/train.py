@@ -65,6 +65,15 @@ def main(argv=None) -> int:
                              "crash-kind injected fault")
     parser.add_argument("--no-flight", action="store_true",
                         help="disable the crash flight recorder")
+    parser.add_argument("--monitor-port", type=int, default=None,
+                        metavar="PORT",
+                        help="serve /metrics (Prometheus text "
+                             "exposition from the live metrics "
+                             "registry), /healthz, and /readyz on this "
+                             "port for the whole run (0 = ephemeral). "
+                             "/readyz flips 200 once the training "
+                             "datasets are prepared "
+                             "(OBSERVABILITY.md §live monitoring)")
     args = parser.parse_args(argv)
     if (args.resume and args.checkpoint_dir
             and os.path.abspath(args.resume)
@@ -108,6 +117,27 @@ def main(argv=None) -> int:
             obs.enable()
         from photon_tpu.obs import flight
 
+        # Live monitoring (obs/monitor.py): /healthz answers as soon as
+        # the exporter binds; /readyz follows the registry's
+        # train_datasets_prepared gauge (set by _run after prepare) —
+        # a long training run is observable by PULLING, not only from
+        # its end-of-run summary/JSONL artifacts.
+        mon = None
+        if args.monitor_port is not None:
+            from photon_tpu.obs import monitor
+
+            def _train_ready():
+                gauges = obs.REGISTRY.snapshot()["gauges"]
+                prepared = gauges.get("train_datasets_prepared", 0) >= 1
+                return prepared, {"datasets_prepared": prepared}
+
+            mon = monitor.MonitorServer(
+                args.monitor_port, readiness=_train_ready
+            ).start()
+            logging.getLogger("photon.train").info(
+                "monitor endpoints on port %d "
+                "(/metrics /healthz /readyz)", mon.port)
+
         # _run installs the CLI's own recorder (unless --no-flight);
         # dump/uninstall below are gated on that install actually having
         # happened, so an embedding caller's ambient recorder is never
@@ -125,6 +155,8 @@ def main(argv=None) -> int:
                 flight.dump(f"exception:{type(exc).__name__}")
             raise
         finally:
+            if mon is not None:
+                mon.stop()
             # Uninstall FIRST: it restores the telemetry flag to the
             # state it found at install time (inside _run), and the
             # --telemetry/--trace restore below must win over it.
@@ -503,6 +535,10 @@ def _run(args) -> int:
     try:
         with obs.logged_span("prepare training datasets", log):
             estimator.prepare(train, validation, initial_model)
+        # Readiness signal for `--monitor-port`'s /readyz (and a useful
+        # /metrics fact on its own). Registry mutations are not gated
+        # on the telemetry flag, so the probe works with telemetry off.
+        obs.REGISTRY.gauge("train_datasets_prepared").set(1)
         with obs.logged_span("train models", log), \
                 obs.profile_session(
                     cfg.profile_dir, name="train_fit_profile"):

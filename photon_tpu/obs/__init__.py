@@ -36,6 +36,21 @@ import time
 from photon_tpu.obs import convergence
 from photon_tpu.obs import flight
 from photon_tpu.obs import trace
+
+
+def __getattr__(name: str):
+    # Lazy submodule (PEP 562): `photon_tpu.obs` is imported by every
+    # training/serving path, and eagerly pulling obs.monitor would tax
+    # each of them with the http.server import chain for a surface
+    # only `--monitor-port` users touch. `from photon_tpu.obs import
+    # monitor` still works — the from-import falls back to this hook.
+    if name == "monitor":
+        import importlib
+
+        return importlib.import_module("photon_tpu.obs.monitor")
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
 from photon_tpu.obs.export import (
     snapshot,
     summary_table,
@@ -89,6 +104,23 @@ PROGRAM_AUDIT = [
         builder="build_trace",
         max_programs=2,
         stable_under=("trace_toggle",),
+        hot_loop=True,
+    ),
+    # `monitor`: the live-monitoring layer (obs/monitor.py). The
+    # serving score program is traced with the layer fully ARMED — the
+    # HTTP exporter up and being scraped, the window ring / hotness
+    # sketch / SLO tracker receiving observations from another thread —
+    # and must stay byte-identical to the all-off base with ZERO added
+    # programs: a scrape is host bookkeeping + socket I/O, never a
+    # traced operand, a callback, or a recompile.
+    dict(
+        name="monitor",
+        entry="obs.monitor exporter + window rings + SLO/hotness "
+        "surfaces over serve.ScorePrograms (scrape under load vs "
+        "all-off)",
+        builder="build_monitor",
+        max_programs=1,
+        stable_under=("monitor_scrape",),
         hot_loop=True,
     ),
 ]
@@ -158,6 +190,7 @@ __all__ = [
     "flight",
     "logged_span",
     "metrics_listener",
+    "monitor",
     "profile_session",
     "reset",
     "set_span_retention",

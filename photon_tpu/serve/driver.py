@@ -220,10 +220,39 @@ def drive(
     out["cold_entity_rate"] = (
         round(cold / lookups, 4) if lookups else None
     )
+    # Per-COORDINATE cold rates over the measured window (same delta
+    # discipline as the aggregate): two coordinates sharing a re_type
+    # can have very different vocabulary coverage, and the aggregate
+    # hides the cold one. The old aggregate field stays for
+    # compatibility.
+    out["cold_entity_rate_by_coordinate"] = {}
+    for nm, cs in qstats.get("per_coordinate", {}).items():
+        warm_cs = warm_stats.get("per_coordinate", {}).get(
+            nm, {"entity_lookups": 0, "cold_lookups": 0}
+        )
+        lk = cs["entity_lookups"] - warm_cs["entity_lookups"]
+        cd = cs["cold_lookups"] - warm_cs["cold_lookups"]
+        out["cold_entity_rate_by_coordinate"][nm] = (
+            round(cd / lk, 4) if lk else None
+        )
     out["batches"] = batches
     out["dispatch_errors"] = (
         qstats["dispatch_errors"] - warm_stats["dispatch_errors"]
     )
+    # Live-monitoring surfaces (photon_tpu.obs.monitor): the sliding
+    # window's p50/p99 (warmup ages out of the ring; whole-run
+    # percentiles above cannot), the SLO burn report, and the
+    # per-coordinate hotness top-K.
+    out["window_latency"] = queue.latency.quantiles_ms()
+    if queue.slo_tracker is not None:
+        out["slo"] = queue.slo_tracker.report()
+    out["hot_entities"] = {
+        nm: [
+            {"key": it["key"], "count": it["count"], "error": it["error"]}
+            for it in items
+        ]
+        for nm, items in queue.hotness_top(5).items()
+    }
     from photon_tpu import obs
 
     if obs.enabled():

@@ -107,6 +107,7 @@ CONCURRENCY_AUDIT = dict(
             "MicroBatchQueue._pending",
             "MicroBatchQueue._closed",
             "MicroBatchQueue._stats",
+            "MicroBatchQueue._coord_stats",
             "MicroBatchQueue._breaker_open",
             "MicroBatchQueue._consecutive_failures",
             "MicroBatchQueue._has_deadlines",
@@ -295,6 +296,10 @@ class MicroBatchQueue:
         breaker_threshold: int | None = None,
         dispatch_retry: "_retry.RetryPolicy | None" = _DISPATCH_RETRY,
         close_timeout_s: float | None = None,
+        slo=None,
+        latency_window_s: float = 10.0,
+        latency_windows: int = 6,
+        hotness_k: int = 64,
     ):
         self.programs = programs
         top = programs.ladder.max_batch
@@ -342,6 +347,39 @@ class MicroBatchQueue:
             "breaker_trips": 0,
             "breaker_rejected": 0,
             "shutdown_stranded": 0,
+        }
+        # Live-monitoring surfaces (photon_tpu.obs.monitor; PR 9).
+        # Per-COORDINATE cold/lookups counters (the global
+        # cold_entity_rate hides a cold coordinate when two coordinates
+        # share a re_type with different vocab coverage) ride the one
+        # queue lock next to _stats; the latency window ring, the SLO
+        # burn tracker, and the per-coordinate hotness sketches each
+        # keep their OWN lock (obs-monitor CONCURRENCY_AUDIT) so a
+        # /metrics scrape never queues behind the dispatch worker.
+        from photon_tpu.obs.monitor import (
+            RollingHistogram,
+            SloTracker,
+            SpaceSavingSketch,
+        )
+
+        random_tables = getattr(
+            getattr(programs, "tables", None), "random", None
+        ) or {}
+        self._coord_stats = {
+            name: {"entity_lookups": 0, "cold_lookups": 0}
+            for name in random_tables
+        }
+        self._re_types = {
+            name: t.random_effect_type
+            for name, t in random_tables.items()
+        }
+        self.latency = RollingHistogram(
+            window_s=latency_window_s, num_windows=latency_windows
+        )
+        self.slo_tracker = None if slo is None else SloTracker(slo)
+        self.hotness = {
+            name: SpaceSavingSketch(hotness_k)
+            for name in random_tables
         }
         self._thread = threading.Thread(
             target=self._worker, name="photon-serve-worker",
@@ -411,6 +449,8 @@ class MicroBatchQueue:
             # takes these paths, is when the cond is hottest.
             outcome, exc = rejection
             _record_request(req, outcome)
+            if self.slo_tracker is not None:
+                self.slo_tracker.observe_errors(1)
             raise exc
         return req.future
 
@@ -461,6 +501,8 @@ class MicroBatchQueue:
         for r in stranded:
             r.future.set_exception(exc)
             _record_request(r, "shutdown")
+        if self.slo_tracker is not None:
+            self.slo_tracker.observe_errors(len(stranded))
         return False
 
     def reset_breaker(self) -> None:
@@ -479,10 +521,21 @@ class MicroBatchQueue:
         self.close(self.close_timeout_s)
 
     def stats(self) -> dict:
-        """Snapshot of the queue counters (+ derived fill/cold rates)."""
+        """Snapshot of the queue counters (+ derived fill/cold rates,
+        per-coordinate cold counters)."""
         with self._cond:
             snap = dict(self._stats)
             snap["queued_now"] = len(self._pending)
+            per_coord = {
+                nm: dict(cs) for nm, cs in self._coord_stats.items()
+            }
+        for nm, cs in per_coord.items():
+            cs["cold_entity_rate"] = (
+                round(cs["cold_lookups"] / cs["entity_lookups"], 4)
+                if cs["entity_lookups"]
+                else None
+            )
+        snap["per_coordinate"] = per_coord
         if snap["batches"]:
             snap["batch_fill_fraction"] = round(
                 snap["batched_requests"]
@@ -508,6 +561,9 @@ class MicroBatchQueue:
         tables' reload generation — what a load balancer's health probe
         (and ``cli.serve`` / ``bench.py``) reads."""
         with self._cond:
+            per_coord = {
+                nm: dict(cs) for nm, cs in self._coord_stats.items()
+            }
             snap = {
                 "queue_depth": len(self._pending),
                 "closed": self._closed,
@@ -529,7 +585,136 @@ class MicroBatchQueue:
         snap["table_generation"] = getattr(
             self.programs.tables, "generation", 0
         )
+        # Live-monitoring block (obs/monitor.py): sliding-window
+        # latency quantiles — the LAST N seconds, not the whole run —
+        # per-coordinate cold rates (copied under the same _cond hold
+        # as the rest of the snapshot), and the declared-SLO burn
+        # report. The ring and the tracker snapshot under their own
+        # locks, outside _cond.
+        window = self.latency.quantiles_ms()
+        window["window_seconds"] = (
+            self.latency.window_s * self.latency.num_windows
+        )
+        snap["window_latency"] = window
+        snap["cold_entity_rate_by_coordinate"] = {
+            nm: (
+                round(cs["cold_lookups"] / cs["entity_lookups"], 4)
+                if cs["entity_lookups"] else None
+            )
+            for nm, cs in per_coord.items()
+        }
+        if self.slo_tracker is not None:
+            snap["slo"] = self.slo_tracker.report()
         return snap
+
+    def hotness_top(self, n: int = 10) -> dict:
+        """Per-coordinate top-``n`` hottest entities (space-saving
+        sketch: counts overestimate by at most their recorded error) —
+        the shard/cache-planning signal of ROADMAP items 1 and 4."""
+        return {
+            nm: sketch.top(n) for nm, sketch in self.hotness.items()
+        }
+
+    def metrics_families(self) -> list[dict]:
+        """The queue's ``/metrics`` collector (register with
+        ``MonitorServer(collectors=[queue.metrics_families])``): live
+        depth/breaker gauges, per-coordinate cold counters, the
+        windowed-latency histogram + quantile gauges, hotness top-K,
+        and the SLO burn gauges. Every number is copied under its own
+        surface's lock and rendered lockless."""
+        from photon_tpu.obs import monitor
+
+        with self._cond:
+            depth = len(self._pending)
+            breaker = self._breaker_open
+            closed = self._closed
+            stats = dict(self._stats)
+            per_coord = {
+                nm: dict(cs) for nm, cs in self._coord_stats.items()
+            }
+        fams = [
+            monitor.family(
+                "serve_queue_depth_live", "gauge",
+                "requests queued at scrape time", [("", {}, depth)],
+            ),
+            monitor.family(
+                "serve_breaker_open_live", "gauge",
+                "1 when the dispatch circuit breaker is open",
+                [("", {}, float(breaker))],
+            ),
+            monitor.family(
+                "serve_queue_closed", "gauge",
+                "1 once close() was called", [("", {}, float(closed))],
+            ),
+            monitor.family(
+                "serve_queue_requests_total", "counter",
+                "requests accepted by the queue",
+                [("", {}, float(stats["requests"]))],
+            ),
+            monitor.family(
+                "serve_queue_events_total", "counter",
+                "degraded-mode queue events by kind",
+                [
+                    ("", {"kind": k}, float(stats[k]))
+                    for k in (
+                        "shed", "deadline_expired", "dispatch_errors",
+                        "dispatch_retries", "breaker_trips",
+                        "breaker_rejected", "shutdown_stranded",
+                    )
+                ],
+            ),
+            monitor.family(
+                "serve_entity_lookups_total", "counter",
+                "entity lookups per random-effect coordinate",
+                [
+                    ("", {"coordinate": nm}, float(cs["entity_lookups"]))
+                    for nm, cs in sorted(per_coord.items())
+                ],
+            ),
+            monitor.family(
+                "serve_cold_entity_lookups_total", "counter",
+                "cold (out-of-vocabulary) lookups per coordinate",
+                [
+                    ("", {"coordinate": nm}, float(cs["cold_lookups"]))
+                    for nm, cs in sorted(per_coord.items())
+                ],
+            ),
+            self.latency.prometheus_family(
+                "serve_request_latency_window_seconds",
+                "submit-to-scatter latency over the sliding window "
+                f"(last {self.latency.window_s * self.latency.num_windows:g}s)",
+            ),
+        ]
+        quantiles = self.latency.quantiles_ms()
+        fams.append(
+            monitor.family(
+                "serve_request_latency_window_ms", "gauge",
+                "sliding-window latency quantiles, milliseconds",
+                [
+                    ("", {"quantile": str(int(q[1:q.index('_')]) / 100)}, v)
+                    for q, v in quantiles.items()
+                    if q.startswith("p") and v is not None
+                ],
+            )
+        )
+        hot_samples = [
+            ("", {"coordinate": nm, "entity": item["key"]},
+             float(item["count"]))
+            for nm, items in sorted(self.hotness_top(10).items())
+            for item in items
+        ]
+        if hot_samples:
+            fams.append(
+                monitor.family(
+                    "serve_hot_entity_requests", "gauge",
+                    "space-saving sketch count per hot entity "
+                    "(overestimates by at most the sketch error)",
+                    hot_samples,
+                )
+            )
+        if self.slo_tracker is not None:
+            fams.extend(self.slo_tracker.prometheus_families())
+        return fams
 
     # -- worker side ------------------------------------------------------
 
@@ -554,15 +739,18 @@ class MicroBatchQueue:
             self._cond.notify_all()  # space freed: wake producers
         return expired
 
-    def _take_batch(self) -> tuple[list[_Request] | None, list[_Request]]:
+    def _take_batch(self):
         """Block for the next batch per the flush policy.
 
-        Runs on the worker thread. Returns ``(batch, expired)``:
-        ``batch`` is None when the queue closed AND drained (exit),
-        possibly-empty when only expirations happened this round;
-        ``expired`` requests failed their deadline while queued and
-        must be resolved by the caller (outside the lock), BEFORE any
-        device work is spent on the batch.
+        Runs on the worker thread. Returns
+        ``(batch, expired, depth, breaker_open)``: ``batch`` is None
+        when the queue closed AND drained (exit), possibly-empty when
+        only expirations happened this round; ``expired`` requests
+        failed their deadline while queued and must be resolved by the
+        caller (outside the lock), BEFORE any device work is spent on
+        the batch; ``depth``/``breaker_open`` are sampled under the
+        same lock hold so the worker's wakeup gauges cost no extra
+        acquisition.
         """
         with self._cond:
             while True:
@@ -618,14 +806,34 @@ class MicroBatchQueue:
                             for r in batch:
                                 r.take_ts = now
                     self._cond.notify_all()  # space freed: wake producers
-                    return batch, expired
+                    return (
+                        batch, expired,
+                        len(self._pending), self._breaker_open,
+                    )
                 if self._closed or expired:
-                    return (None if self._closed else []), expired
+                    return (
+                        (None if self._closed else []), expired,
+                        len(self._pending), self._breaker_open,
+                    )
                 self._cond.wait()
 
     def _worker(self) -> None:
+        from photon_tpu import obs
+
         while True:
-            batch, expired = self._take_batch()
+            # depth/breaker ride out of the lock hold _take_batch
+            # already has — no second _cond acquisition per wakeup.
+            batch, expired, depth, breaker = self._take_batch()
+            if obs.enabled():
+                # Queue-pressure sampling on EVERY worker wakeup: the
+                # depth gauge and breaker state land in the metrics
+                # registry (where /metrics reads them) — not just in
+                # the end-of-run health() snapshot. The trace counter
+                # TRACK is fed from _dispatch (one sample per batch).
+                obs.REGISTRY.gauge("serve_queue_depth").set(depth)
+                obs.REGISTRY.gauge("serve_breaker_open").set(
+                    float(breaker)
+                )
             if expired:
                 exc = DeadlineExceededError(
                     "request deadline expired while queued; failed "
@@ -633,8 +841,8 @@ class MicroBatchQueue:
                 for r in expired:
                     r.future.set_exception(exc)
                     _record_request(r, "expired")
-                from photon_tpu import obs
-
+                if self.slo_tracker is not None:
+                    self.slo_tracker.observe_errors(len(expired))
                 if obs.enabled():
                     obs.REGISTRY.counter(
                         "serve_deadline_expired_total"
@@ -664,17 +872,21 @@ class MicroBatchQueue:
             feats, codes, _rung = self.programs.pack_requests(
                 [(r.features, r.entity_ids) for r in batch]
             )
-            cold = sum(
-                int(np.sum(vec[: len(batch)] < 0))
-                for vec in codes.values()
-            )
+            # Cold lookups PER COORDINATE (codes are keyed by
+            # coordinate, each resolved against its own vocabulary):
+            # the aggregate hides a cold coordinate when two
+            # coordinates share a re_type with different coverage.
+            cold_by_coord = {
+                nm: int(np.sum(vec[: len(batch)] < 0))
+                for nm, vec in codes.items()
+            }
             dispatch_ts = time.perf_counter()
             with obs.span("serve/batch"):
                 scores = self.programs.score_padded(
                     feats, codes, len(batch)
                 )
             scatter_ts = time.perf_counter()
-            return cold, len(codes) * len(batch), scores
+            return cold_by_coord, len(codes) * len(batch), scores
 
         def on_retry(attempt_no, exc):
             with self._cond:
@@ -684,7 +896,7 @@ class MicroBatchQueue:
 
         try:
             if self.dispatch_retry is not None:
-                cold, lookups, scores = _retry.retrying_check(
+                cold_by_coord, lookups, scores = _retry.retrying_check(
                     "serve.dispatch", attempt,
                     site="serve.dispatch",
                     policy=self.dispatch_retry,
@@ -694,7 +906,7 @@ class MicroBatchQueue:
                 from photon_tpu.resilience import faults
 
                 faults.check("serve.dispatch")
-                cold, lookups, scores = attempt()
+                cold_by_coord, lookups, scores = attempt()
         except Exception as exc:  # noqa: BLE001 — fan out to the waiters
             drained: list[_Request] = []
             with self._cond:
@@ -737,13 +949,31 @@ class MicroBatchQueue:
                         consecutive_failures=self._consecutive_failures,
                         drained=len(drained),
                     )
+            if self.slo_tracker is not None:
+                self.slo_tracker.observe_errors(len(batch) + len(drained))
             return
+        cold = sum(cold_by_coord.values())
         with self._cond:
             self._consecutive_failures = 0
             self._stats["cold_lookups"] += cold
             self._stats["entity_lookups"] += lookups
+            for nm, c in cold_by_coord.items():
+                cs = self._coord_stats[nm]
+                cs["entity_lookups"] += len(batch)
+                cs["cold_lookups"] += c
             batch_no = self._stats["batches"]
             depth = len(self._pending)
+        # Hotness sketches + SLO lookup budget: outside the queue lock
+        # (each surface has its own lock; obs-monitor CONCURRENCY_AUDIT).
+        for nm in cold_by_coord:
+            sketch = self.hotness[nm]
+            rt = self._re_types[nm]
+            for r in batch:
+                key = r.entity_ids.get(rt)
+                if key is not None:
+                    sketch.observe(key)
+        if self.slo_tracker is not None:
+            self.slo_tracker.observe_lookups(lookups, cold)
         if obs.enabled():
             obs.REGISTRY.counter("serve_requests_total").inc(len(batch))
             obs.REGISTRY.counter("serve_batches_total").inc()
@@ -759,6 +989,14 @@ class MicroBatchQueue:
             # exported timeline (how the backlog breathes under load).
             obs.trace.counter("serve_queue_depth", depth)
         for r, s in zip(batch, scores):
+            # Submit→scatter is the request's SERVICE latency — the
+            # number the rolling window ring and the latency SLO judge.
+            # Measured BEFORE resolution so a slow driver done-callback
+            # can never inflate the served tail.
+            latency = scatter_ts - r.enqueued_at
+            self.latency.observe(latency)
+            if self.slo_tracker is not None:
+                self.slo_tracker.observe_request(latency)
             r.future.set_result(float(s))
             # done_ts lands AFTER resolution: scatter→done covers the
             # result fan-out including the driver's done-callbacks.

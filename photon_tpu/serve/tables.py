@@ -140,6 +140,28 @@ class CoefficientTables:
                 seen.append(t.random_effect_type)
         return tuple(seen)
 
+    def coordinate_stats(self) -> dict:
+        """Per-coordinate shape/vocabulary facts for the monitoring and
+        readiness surfaces (``cli.serve --monitor-port``'s ``/readyz``
+        detail, the bench JSON): which coordinates are live, how many
+        entities each random table can resolve, and the generation —
+        enough to see a mis-sized vocabulary without pulling arrays."""
+        return {
+            "generation": self.generation,
+            "fixed": {
+                n: {"features": t.num_features}
+                for n, t in self.fixed.items()
+            },
+            "random": {
+                n: {
+                    "entities": t.num_entities,
+                    "re_type": t.random_effect_type,
+                    "sub_dim": int(t.weights.shape[1]),
+                }
+                for n, t in self.random.items()
+            },
+        }
+
     def codes_for(self, entity_ids: dict) -> dict[str, int]:
         """Per-COORDINATE row codes for one request (-1 = cold); the
         request's entity id is keyed by the coordinate's re_type."""

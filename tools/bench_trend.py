@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Script entry for the bench trend gate — see
+``photon_tpu/cli/benchtrend.py`` for the tool itself (one
+implementation, two spellings: ``python tools/bench_trend.py`` and
+``python -m photon_tpu.cli.benchtrend``)."""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from photon_tpu.cli.benchtrend import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
