@@ -169,6 +169,16 @@ SERVE_COLD_FRACTION = 0.05
 SERVE_RUNGS = (1, 8, 64, 512)
 SERVE_MAX_LINGER_MS = 1.0
 
+# Streaming scenario sizing (photon_tpu.data.stream; DATA.md). Day-1
+# stream-ingests Avro shards from disk and trains; day-2 re-streams and
+# warm-starts from day-1's model — `incremental_rows_per_sec` is the
+# daily-cadence retrain cost the out-of-core path exists for.
+STREAM_ROWS = 120_000
+STREAM_SHARDS = 8
+STREAM_FEATURES = 8
+STREAM_USERS = 2_000
+STREAM_WINDOW_SHARDS = 2
+
 YAHOO_TRAIN = (
     "/root/reference/photon-client/src/integTest/resources/GameIntegTest/"
     "input/duplicateFeatures/yahoo-music-train.avro"
@@ -737,6 +747,166 @@ def run_serving() -> dict:
     }
 
 
+def _write_stream_shards(shard_dir: str) -> None:
+    """STREAM_ROWS synthetic TrainingExampleAvro rows across
+    STREAM_SHARDS part files (sparse power-law-ish features + a userId
+    metadata tag) — the on-disk workload the streaming scenario reads
+    back out-of-core."""
+    from photon_tpu.io.avro_data import write_training_examples
+    from photon_tpu.types import DELIMITER
+
+    os.makedirs(shard_dir, exist_ok=True)
+    rng = np.random.default_rng(20260803)
+    per = STREAM_ROWS // STREAM_SHARDS
+    base = 0
+    for si in range(STREAM_SHARDS):
+        n = per if si < STREAM_SHARDS - 1 else STREAM_ROWS - base
+        feats = rng.integers(0, STREAM_FEATURES, size=(n, 3))
+        vals = rng.normal(size=(n, 3))
+        z = vals.sum(axis=1) * 0.4
+        y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-z))).astype(float)
+        rows = [
+            [(f"f{feats[i, j]}{DELIMITER}t", float(vals[i, j]))
+             for j in range(3)]
+            for i in range(n)
+        ]
+        meta = [
+            {"userId": f"u{rng.integers(0, STREAM_USERS)}"}
+            for _ in range(n)
+        ]
+        write_training_examples(
+            os.path.join(shard_dir, f"part-{si:05d}.avro"),
+            y, rows, metadata=meta, uids=np.arange(base, base + n),
+        )
+        base += n
+
+
+def _stream_estimator():
+    from photon_tpu import optim
+    from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
+    from photon_tpu.data.random_effect import RandomEffectDataConfiguration
+    from photon_tpu.estimators.game_estimator import (
+        FixedEffectCoordinateConfiguration,
+        GameEstimator,
+        RandomEffectCoordinateConfiguration,
+    )
+    from photon_tpu.types import TaskType
+
+    def l2(w):
+        return GLMOptimizationConfiguration(
+            regularization=optim.RegularizationContext(
+                optim.RegularizationType.L2
+            ),
+            regularization_weight=w,
+        )
+
+    return GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {
+            "global": FixedEffectCoordinateConfiguration(
+                "features", l2(1e-2)),
+            "per-user": RandomEffectCoordinateConfiguration(
+                RandomEffectDataConfiguration("userId", "features"),
+                l2(1.0),
+            ),
+        },
+        num_iterations=2,
+        mesh="off",
+    )
+
+
+def run_streaming() -> dict:
+    """The `streaming` scenario: out-of-core ingest + warm-start retrain.
+
+    Day 1 streams STREAM_SHARDS Avro shards from disk through
+    ``StreamingIngest`` (bounded-memory windows, integrity manifest,
+    resumable cursor) and trains a GLMix model; day 2 re-streams and
+    warm-starts from day-1's model (``fit(init_model=...)``) — the
+    reported ``streaming_incremental_rows_per_sec`` is rows over the
+    WHOLE day-2 wall (ingest + warm fit), the daily-cadence retrain
+    cost. ``streaming_ingested_fraction`` must be 1.0 and the
+    quarantine counters 0 on this clean run (gated in
+    streaming_regressions); peak host RSS rides along as the
+    out-of-core memory gauge.
+    """
+    import resource
+    import shutil
+    import tempfile
+
+    from photon_tpu.data.stream import StreamingIngest
+    from photon_tpu.io.model_io import save_checkpoint
+
+    tmp = tempfile.mkdtemp(prefix="photon_stream_bench")
+    try:
+        shard_dir = os.path.join(tmp, "shards")
+        t0 = time.perf_counter()
+        _write_stream_shards(shard_dir)
+        write_seconds = time.perf_counter() - t0
+
+        def ingest(work):
+            return StreamingIngest(
+                shard_dir,
+                work_dir=os.path.join(tmp, work),
+                window_shards=STREAM_WINDOW_SHARDS,
+            ).run()
+
+        t0 = time.perf_counter()
+        day1, stats1 = ingest("work-day1")
+        est1 = _stream_estimator()
+        result1 = est1.fit(day1)[0]
+        day1_seconds = time.perf_counter() - t0
+        ckpt = os.path.join(tmp, "day1-model.npz")
+        save_checkpoint(result1.model, ckpt)
+
+        # Day 2: fresh process state (new estimator, re-streamed data),
+        # warm-started from yesterday's model — jit/compile caches are
+        # warm, which is exactly the daily-cadence cost being measured.
+        t0 = time.perf_counter()
+        day2, stats2 = ingest("work-day2")
+        est2 = _stream_estimator()
+        est2.fit(day2, init_model=ckpt)
+        day2_seconds = time.perf_counter() - t0
+
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return {
+            "streaming_rows": STREAM_ROWS,
+            "streaming_shards": STREAM_SHARDS,
+            "streaming_window_shards": STREAM_WINDOW_SHARDS,
+            "streaming_shard_write_seconds": round(write_seconds, 3),
+            "streaming_ingest_rows_per_sec": stats1["rows_per_sec"],
+            "streaming_ingest_seconds": stats1["wall_seconds"],
+            "streaming_day1_seconds": round(day1_seconds, 3),
+            "streaming_day2_seconds": round(day2_seconds, 3),
+            "streaming_incremental_rows_per_sec": round(
+                STREAM_ROWS / day2_seconds, 1),
+            "streaming_ingested_fraction": stats2["ingested_fraction"],
+            "streaming_quarantined_shards": stats2["shards_quarantined"],
+            "streaming_peak_host_rss_mb": round(rss_kb / 1024.0, 1),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def streaming_regressions(streaming: dict) -> list[str]:
+    """Streaming entries for the output's `regressions` list: a clean
+    run must ingest EVERYTHING (fraction 1.0, zero quarantines) and the
+    incremental gauge must engage."""
+    out = []
+    if streaming.get("streaming_ingested_fraction") != 1.0:
+        out.append(
+            "clean streaming run ingested fraction "
+            f"{streaming.get('streaming_ingested_fraction')} != 1.0")
+    if streaming.get("streaming_quarantined_shards", 0) != 0:
+        out.append(
+            f"clean streaming run quarantined "
+            f"{streaming['streaming_quarantined_shards']} shard(s)")
+    if not streaming.get("streaming_incremental_rows_per_sec"):
+        out.append(
+            "streaming scenario missing "
+            "streaming_incremental_rows_per_sec (gauge dead)")
+    return out
+
+
 def roofline_regressions(name: str, cost_model: dict) -> list[str]:
     """The ``measured_vs_roofline`` gate (a tracked bench metric since
     round 8, not just a report field). A missing ratio is NOT a
@@ -1108,21 +1278,27 @@ def _apply_smoke():
     TPU-scale regression floors do not apply to it.
     """
     global N_ROWS, N_USERS, N_MOVIES, MIN_MEASURE_SECONDS
-    global N_SERVE_REQUESTS
+    global N_SERVE_REQUESTS, STREAM_ROWS, STREAM_SHARDS, STREAM_USERS
     N_ROWS = 20_000
     N_USERS = 500
     N_MOVIES = 100
     MIN_MEASURE_SECONDS = 0.2
     N_SERVE_REQUESTS = 1_500
+    # The 2-core CI box pays only a tiny shard set (--streaming opt-in).
+    STREAM_ROWS = 6_000
+    STREAM_SHARDS = 6
+    STREAM_USERS = 120
 
 
-def run_smoke() -> dict:
+def run_smoke(streaming: bool = False) -> dict:
     """`bench.py --smoke`: the linear variant at CI scale, one JSON line.
 
     Asserts (in the output, for the CI job to check) that the pipeline
     stats were emitted with every per-stage field present and that the
     telemetry layer actually engaged (span tree recorded, convergence
-    series captured from inside the fused fit)."""
+    series captured from inside the fused fit). ``streaming`` adds the
+    out-of-core scenario at CI scale — behind a flag so the default
+    smoke wall stays bounded on the 2-core box."""
     from photon_tpu import obs
 
     lin = run_variant("linear")
@@ -1161,6 +1337,10 @@ def run_smoke() -> dict:
     # serve spans/metrics land in the smoke output's telemetry too.
     serving = run_serving()
     regressions.extend(serving_regressions(serving))
+    streaming_out = {}
+    if streaming:
+        streaming_out = run_streaming()
+        regressions.extend(streaming_regressions(streaming_out))
     regressions.extend(resilience_regressions())
     for key in ("serving_p50_ms", "serving_p99_ms", "serving_qps"):
         if serving.get(key) is None:
@@ -1199,6 +1379,7 @@ def run_smoke() -> dict:
     }
     out.update(_variant_fields("linear", lin))
     out.update(serving)
+    out.update(streaming_out)
     out["telemetry"] = telemetry
     return out
 
@@ -1213,6 +1394,13 @@ def main(argv=None):
         "--smoke", action="store_true",
         help="CI-scale run: linear variant only, pipeline-stats assertion, "
         "no TPU-scale floors",
+    )
+    parser.add_argument(
+        "--streaming", action="store_true",
+        help="with --smoke: also run the out-of-core streaming "
+        "scenario (write synthetic shards, stream-train day 1, "
+        "warm-start retrain day 2) at CI scale; the full bench always "
+        "includes it",
     )
     parser.add_argument(
         "--telemetry", default=None, metavar="PATH",
@@ -1242,7 +1430,7 @@ def main(argv=None):
 
     if args.smoke:
         _apply_smoke()
-        out = run_smoke()
+        out = run_smoke(streaming=args.streaming)
         from photon_tpu.utils import cache_stats
 
         out["compile_cache"] = cache_stats()
@@ -1256,6 +1444,7 @@ def main(argv=None):
     logi = run_variant("logistic")
     lin = run_variant("linear")
     serving = run_serving()
+    streaming = run_streaming()
     sklearn_anchor = run_sklearn_baseline(logi["train_seconds"])
     yahoo = run_yahoo_music()
     a9a = run_a1a_logistic()
@@ -1278,6 +1467,7 @@ def main(argv=None):
             f"{FLOORS['logistic_compile_seconds_max']:.1f}")
     regressions.extend(roofline_regressions("logistic", logi["cost_model"]))
     regressions.extend(serving_regressions(serving))
+    regressions.extend(streaming_regressions(streaming))
     regressions.extend(resilience_regressions())
 
     out = {
@@ -1298,6 +1488,7 @@ def main(argv=None):
     for name, v in (("logistic", logi), ("linear", lin)):
         out.update(_variant_fields(name, v))
     out.update(serving)
+    out.update(streaming)
     out.update(sklearn_anchor)
     out.update(yahoo)
     out.update(a9a)

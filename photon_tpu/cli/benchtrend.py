@@ -53,6 +53,12 @@ TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
     "logistic_measured_vs_roofline": ("lower", 1.5, ()),
     "serving_p99_ms": ("lower", 1.5, ()),
     "serving_qps": ("higher", 1.5, ()),
+    # Streaming scenario (round 10+, photon_tpu.data.stream): the
+    # day-over-day warm-start retrain throughput and the out-of-core
+    # ingest rate — a streaming-throughput regression fails the trend
+    # gate the round it happens, same policy as the serving block.
+    "streaming_incremental_rows_per_sec": ("higher", 1.5, ()),
+    "streaming_ingest_rows_per_sec": ("higher", 1.5, ()),
 }
 
 

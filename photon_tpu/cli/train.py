@@ -40,6 +40,45 @@ def main(argv=None) -> int:
                              "checkpoint (implies --checkpoint-dir DIR; "
                              "the manifest's config static key must "
                              "match this run's configuration)")
+    parser.add_argument("--stream-dir", default=None, metavar="DIR",
+                        help="fault-tolerant out-of-core streaming "
+                             "ingest: train from DIR's Avro shards in "
+                             "bounded-memory windows with per-shard "
+                             "integrity checks, transient-I/O retry, "
+                             "and a resumable cursor — instead of the "
+                             "config's whole-dataset train_path load "
+                             "(DATA.md)")
+    parser.add_argument("--resume-ingest", action="store_true",
+                        help="resume a killed streaming ingest from its "
+                             "committed cursor (window spills are "
+                             "reloaded; the resumed dataset is byte-"
+                             "identical to the uninterrupted run). "
+                             "Requires --stream-dir")
+    parser.add_argument("--stream-window", type=int, default=1,
+                        metavar="N",
+                        help="shards per streaming window (decode of "
+                             "window k+1 overlaps window k's device "
+                             "transfer; default 1 = cursor commits at "
+                             "every shard boundary)")
+    parser.add_argument("--max-bad-shards", type=int, default=0,
+                        metavar="N",
+                        help="quarantine budget: tolerate up to N "
+                             "corrupt shards (skip + count + surface "
+                             "ingested_fraction; default 0 = abort on "
+                             "the first corrupt shard)")
+    parser.add_argument("--max-bad-fraction", type=float, default=0.0,
+                        metavar="F",
+                        help="quarantine budget as a fraction of the "
+                             "shard count (combined with "
+                             "--max-bad-shards via max)")
+    parser.add_argument("--init-model", default=None, metavar="PATH",
+                        help="day-over-day warm start: load yesterday's "
+                             "GameModel (a native checkpoint .npz or an "
+                             "Avro model directory) as the initial "
+                             "model; its digest is recorded in the "
+                             "training checkpoint manifest so crash "
+                             "recovery resumes ingest-then-descent "
+                             "end to end")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("--log-file", default=None,
                         help="also write logs to this file (PhotonLogger "
@@ -85,6 +124,8 @@ def main(argv=None) -> int:
             "--resume and --checkpoint-dir point at different "
             f"directories ({args.resume} vs {args.checkpoint_dir}); "
             "--resume DIR already implies --checkpoint-dir DIR")
+    if args.resume_ingest and not args.stream_dir:
+        parser.error("--resume-ingest requires --stream-dir")
 
     if args.backend:
         os.environ["JAX_PLATFORMS"] = args.backend
@@ -344,7 +385,88 @@ def _run(args) -> int:
             "(IdentityIndexMapLoader semantics)")
 
     multi_shard_maps = None
-    if cfg.input_format == "avro" and cfg.feature_shards:
+    stream_stats = None
+    stream_work_dir = None
+    if args.stream_dir:
+        # ------------------------------------------------------------------
+        # streaming ingest (photon_tpu.data.stream; DATA.md)
+        # ------------------------------------------------------------------
+        if cfg.input_format != "avro":
+            raise ValueError(
+                "--stream-dir streams Avro shards; set input.format to "
+                "avro")
+        if cfg.date_range or cfg.days_range:
+            raise ValueError(
+                "--stream-dir does not combine with date_range/"
+                "days_range; point it at the day directory instead")
+        from photon_tpu.data.stream import (
+            QuarantinePolicy,
+            StreamingIngest,
+        )
+
+        # Co-locate the ingest work dir (manifest/vocab/spills/cursor)
+        # with the training checkpoints when crash safety is on, so one
+        # directory carries the WHOLE recovery chain; else the output
+        # dir.
+        stream_work_dir = os.path.join(
+            args.checkpoint_dir or args.resume or cfg.output_dir,
+            "ingest-work")
+        shard_bags = cfg.shard_bags()
+        ingest = StreamingIngest(
+            args.stream_dir,
+            work_dir=stream_work_dir,
+            feature_shards=shard_bags,
+            index_maps=prebuilt_maps,
+            id_tag_names=cfg.id_tags,
+            id_columns=cfg.id_columns,
+            input_columns=cfg.input_columns,
+            add_intercept=(
+                cfg.shard_intercepts() if shard_bags else True
+            ),
+            window_shards=args.stream_window,
+            quarantine=QuarantinePolicy(
+                args.max_bad_shards, args.max_bad_fraction
+            ),
+            resume=args.resume_ingest,
+        )
+        with obs.logged_span("stream ingest", log):
+            train, stream_stats = ingest.run()
+        log.info(
+            "streamed %d row(s) from %d/%d shard(s) "
+            "(ingested_fraction %.4f%s)",
+            stream_stats["rows_ingested"],
+            stream_stats["shards_ingested"],
+            stream_stats["shards_total"],
+            stream_stats["ingested_fraction"],
+            f", resumed at shard {stream_stats['resumed_from_shard']}"
+            if stream_stats["resumed_from_shard"] is not None else "",
+        )
+        if stream_stats["quarantined_paths"]:
+            log.warning(
+                "streaming ingest quarantined %d shard(s): %s",
+                stream_stats["shards_quarantined"],
+                ", ".join(stream_stats["quarantined_paths"]))
+        multi_shard_maps = ingest.resolved_maps
+        index_map = next(iter(multi_shard_maps.values()))
+        validation = None
+        if cfg.validation_path:
+            if shard_bags:
+                validation, _ = read_merged(
+                    cfg.validation_path,
+                    feature_shards=shard_bags,
+                    index_maps=multi_shard_maps,
+                    id_columns=cfg.id_columns,
+                    id_tag_names=list(ingest.id_tag_names),
+                    input_columns=cfg.input_columns,
+                )
+            else:
+                validation, _ = read_training_examples(
+                    cfg.validation_path,
+                    index_map=multi_shard_maps["features"],
+                    id_tag_names=list(ingest.id_tag_names),
+                    input_columns=cfg.input_columns,
+                )
+    elif cfg.input_format == "avro" and cfg.feature_shards:
         if prebuilt_maps is not None:
             missing = sorted(set(cfg.feature_shards) - set(prebuilt_maps))
             if missing:
@@ -434,7 +556,20 @@ def _run(args) -> int:
     # warm start (loadGameModelFromHDFS :395-404)
     # ------------------------------------------------------------------
     initial_model = None
-    if cfg.warm_start_model_dir:
+    init_model_digest = None
+    if args.init_model:
+        if cfg.warm_start_model_dir:
+            raise ValueError(
+                "--init-model and the config's warm_start_model_dir are "
+                "both set; pass exactly one warm-start source")
+        from photon_tpu.io.model_io import load_initial_model
+
+        initial_model, init_model_digest = load_initial_model(
+            args.init_model, index_maps
+        )
+        log.info("warm start from --init-model %s (digest %s...)",
+                 args.init_model, init_model_digest[:12])
+    elif cfg.warm_start_model_dir:
         initial_model, _ = load_game_model(
             cfg.warm_start_model_dir, index_maps
         )
@@ -506,6 +641,32 @@ def _run(args) -> int:
 
         static_key = training_static_key(estimator, opt_seq)
         checkpointer = TrainingCheckpointer(ckpt_dir, static_key)
+        # Run provenance rides every manifest commit: the streaming-
+        # ingest cursor (work dir + pinned shard-manifest hash) and the
+        # init-model digest, so a crash at ANY point recovers end to
+        # end — `--stream-dir --resume-ingest --resume DIR` replays
+        # ingest from its cursor (spill reloads, byte-identical data)
+        # and the descent from its checkpoint, against a verifiable
+        # warm-start identity.
+        run_meta = {}
+        if stream_stats is not None:
+            run_meta["ingest_cursor"] = {
+                "stream_dir": os.path.abspath(args.stream_dir),
+                "work_dir": os.path.abspath(stream_work_dir),
+                "manifest_sha256": stream_stats.get("manifest_sha256"),
+                "rows_ingested": stream_stats.get("rows_ingested"),
+                "ingested_fraction":
+                    stream_stats.get("ingested_fraction"),
+                "quarantined_shards":
+                    stream_stats.get("shards_quarantined"),
+            }
+        if init_model_digest is not None:
+            run_meta["init_model"] = {
+                "path": os.path.abspath(args.init_model),
+                "sha256": init_model_digest,
+            }
+        if run_meta:
+            checkpointer.set_run_meta(run_meta)
         if args.resume:
             resume_state = load_training_checkpoint(args.resume)
             log.info(
@@ -654,6 +815,11 @@ def _run(args) -> int:
         ],
         "wall_clock_seconds": round(time.time() - t_start, 2),
     }
+    if stream_stats is not None:
+        # The streaming-ingest health block: ingested_fraction +
+        # quarantined paths land in the summary artifact (and the
+        # stream_* registry gauges feed /metrics for --monitor-port).
+        summary["streaming_ingest"] = stream_stats
     if args.telemetry:
         # The unified telemetry snapshot (span tree with host/device
         # split, metrics, convergence series, pipeline + compile-cache

@@ -990,6 +990,7 @@ class GameEstimator:
         ) = None,
         initial_model: GameModel | None = None,
         *,
+        init_model=None,
         checkpointer=None,
         resume=None,
     ) -> list[GameFitResult]:
@@ -999,6 +1000,13 @@ class GameEstimator:
         (GameEstimator.train :452-468); ``initial_model`` seeds the first
         (warm-start / partial-retrain model loading,
         GameTrainingDriver.scala:395-404).
+
+        ``init_model`` is the day-over-day warm-start form of the same
+        parameter: a ``GameModel``, or a PATH to yesterday's saved model
+        loaded via ``io/model_io.load_initial_model`` (a native
+        checkpoint ``.npz`` here — Avro model directories need feature
+        index maps, which the CLI layer owns). Exactly one of
+        ``initial_model`` / ``init_model`` may be given.
 
         ``checkpointer`` (a ``resilience.TrainingCheckpointer``) commits
         a crash-safe recovery point after every outer CD iteration;
@@ -1021,6 +1029,18 @@ class GameEstimator:
         the unfused CD loop — crash safety trades away the whole-fit
         fused program by design.
         """
+        if init_model is not None:
+            if initial_model is not None:
+                raise ValueError(
+                    "pass exactly one of initial_model / init_model")
+            if isinstance(init_model, str):
+                from photon_tpu.io.model_io import load_initial_model
+
+                init_model, digest = load_initial_model(init_model)
+                logger.info(
+                    "warm start from init model (digest %s...)",
+                    digest[:12])
+            initial_model = init_model
         if self.incremental_training:
             self._validate_incremental(initial_model)
         datasets, val_ctx = self.prepare(

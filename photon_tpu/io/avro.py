@@ -391,22 +391,14 @@ def schema_to_program(node, _stack=None):
         stack.discard(key)
 
 
-def iter_container(path: str):
-    """Stream an Avro object container file block by block.
-
-    Generator of decoded records: at any moment only ONE decompressed block
-    (``sync_interval`` records, default 4000) of Python dicts is alive —
-    the O(batch) decode the ingest pipeline builds its arrays from. The
-    file handle closes when the generator is exhausted or dropped.
-
-    Blocks decode through the native C decoder when it is available
-    (photon_tpu/native, ~40x the interpreter codec); the interpreter path
-    remains the behavioral reference and the fallback.
-    """
+def _decode_blocks(blocks):
+    """Record stream over (schema_json, count, payload_bytes) blocks —
+    the shared decode dispatch of the path- and bytes-based container
+    iterators (native C decoder when available, interpreter fallback)."""
     from photon_tpu.native import get_avro_decoder
 
     schema = program = native = None
-    for schema_json, count, data in iter_container_block_bytes(path):
+    for schema_json, count, data in blocks:
         if schema is None:
             schema = Schema(schema_json)
             program = schema_to_program(schema.root)
@@ -419,6 +411,33 @@ def iter_container(path: str):
                 yield _decode(block, schema.root)
 
 
+def iter_container(path: str):
+    """Stream an Avro object container file block by block.
+
+    Generator of decoded records: at any moment only ONE decompressed block
+    (``sync_interval`` records, default 4000) of Python dicts is alive —
+    the O(batch) decode the ingest pipeline builds its arrays from. The
+    file handle closes when the generator is exhausted or dropped.
+
+    Blocks decode through the native C decoder when it is available
+    (photon_tpu/native, ~40x the interpreter codec); the interpreter path
+    remains the behavioral reference and the fallback.
+    """
+    yield from _decode_blocks(iter_container_block_bytes(path))
+
+
+def iter_container_bytes(data: bytes, *, name: str = "<bytes>"):
+    """Stream records from an IN-MEMORY Avro container.
+
+    The streaming ingest's read-once path: the shard's bytes are read
+    from disk a single time (hashed for the integrity manifest), then
+    decoded from the same buffer — no second disk pass, and no TOCTOU
+    window between the checksum and the decode. ``name`` labels parse
+    errors the way a path would.
+    """
+    yield from _decode_blocks(_iter_blocks(io.BytesIO(data), name))
+
+
 def iter_container_block_bytes(path: str):
     """Yield (schema_json, count, payload_bytes) per container block.
 
@@ -427,26 +446,30 @@ def iter_container_block_bytes(path: str):
     tests re-encode decoded records and compare against this byte stream.
     """
     with open(path, "rb") as f:
-        if f.read(4) != MAGIC:
-            raise ValueError(f"{path}: not an Avro container file")
-        meta = _decode(f, _META_SCHEMA)
-        schema_json = json.loads(meta["avro.schema"].decode())
-        codec = meta.get("avro.codec", b"null").decode()
-        sync = f.read(SYNC_SIZE)
-        while True:
-            try:
-                count = _read_long(f)
-            except EOFError:
-                break
-            size = _read_long(f)
-            data = f.read(size)
-            if codec == "deflate":
-                data = zlib.decompress(data, wbits=-15)
-            elif codec != "null":
-                raise ValueError(f"unsupported codec {codec!r}")
-            yield schema_json, count, data
-            if f.read(SYNC_SIZE) != sync:
-                raise ValueError(f"{path}: sync marker mismatch")
+        yield from _iter_blocks(f, path)
+
+
+def _iter_blocks(f, label: str):
+    if f.read(4) != MAGIC:
+        raise ValueError(f"{label}: not an Avro container file")
+    meta = _decode(f, _META_SCHEMA)
+    schema_json = json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = f.read(SYNC_SIZE)
+    while True:
+        try:
+            count = _read_long(f)
+        except EOFError:
+            break
+        size = _read_long(f)
+        data = f.read(size)
+        if codec == "deflate":
+            data = zlib.decompress(data, wbits=-15)
+        elif codec != "null":
+            raise ValueError(f"unsupported codec {codec!r}")
+        yield schema_json, count, data
+        if f.read(SYNC_SIZE) != sync:
+            raise ValueError(f"{label}: sync marker mismatch")
 
 
 def encode_records(schema_json: dict, records) -> bytes:

@@ -651,6 +651,58 @@ def save_checkpoint(
     return digest
 
 
+def artifact_digest(path: str) -> str:
+    """Stable sha256 identity of a model artifact — a checkpoint npz's
+    content hash, or (for an Avro model DIRECTORY) the hash of every
+    file's (relative name, content hash) pair in sorted order. The
+    training checkpointer records this for the run's init model so a
+    resumed day-over-day retrain can prove it is warm-starting from the
+    SAME yesterday-model the interrupted run used."""
+    h = hashlib.sha256()
+    if os.path.isfile(path):
+        with open(path, "rb") as f:
+            for block in iter(lambda: f.read(1 << 20), b""):
+                h.update(block)
+        return h.hexdigest()
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, path)
+            h.update(rel.encode())
+            with open(full, "rb") as f:
+                for block in iter(lambda: f.read(1 << 20), b""):
+                    h.update(block)
+    return h.hexdigest()
+
+
+def load_initial_model(
+    path: str, index_maps: dict[str, IndexMap] | None = None
+) -> tuple[GameModel, str]:
+    """Load a warm-start model from either artifact form.
+
+    ``path`` may be a native checkpoint (``.npz``, self-contained) or a
+    reference Avro model directory (needs ``index_maps`` to key the
+    name+term records). Returns ``(model, digest)`` — the digest is the
+    ``artifact_digest`` identity the training checkpointer records so
+    an ingest-then-descent resume can verify its warm start.
+    """
+    if os.path.isfile(path) or path.endswith(".npz"):
+        return load_checkpoint(path), artifact_digest(_ckpt_path(path))
+    if os.path.isfile(os.path.join(path, METADATA_FILE)):
+        if index_maps is None:
+            raise ValueError(
+                f"init model {path} is an Avro model directory; loading "
+                "it needs the feature index maps (name+term keyed "
+                "records) — pass index_maps, or point at a native "
+                ".npz checkpoint instead")
+        model, _ = load_game_model(path, index_maps)
+        return model, artifact_digest(path)
+    raise FileNotFoundError(
+        f"init model {path}: neither a checkpoint npz nor an Avro "
+        f"model directory (no {METADATA_FILE})")
+
+
 def load_checkpoint(path: str) -> GameModel:
     """Load a native checkpoint; see ``load_checkpoint_meta`` for the
     embedded loop-state metadata."""

@@ -51,6 +51,7 @@ from photon_tpu.resilience.errors import (
     CheckpointError,
     CircuitOpenError,
     CorruptModelError,
+    CorruptShardError,
     DeadlineExceededError,
     InjectedCrash,
     NonFiniteUpdateError,
@@ -93,6 +94,7 @@ __all__ = [
     "CheckpointError",
     "CircuitOpenError",
     "CorruptModelError",
+    "CorruptShardError",
     "DeadlineExceededError",
     "FaultPlan",
     "FaultSpec",

@@ -175,6 +175,19 @@ class TrainingCheckpointer:
         # still at ci — must pin its own final explicitly or destroy
         # the artifact the resume path depends on.
         self._final_fname: str | None = None
+        # Run-scoped provenance riding every manifest commit (the
+        # ``run`` block): the streaming-ingest cursor location/manifest
+        # hash and the init-model digest, so crash recovery can resume
+        # ingest-then-descent END TO END — the manifest records not
+        # just where the descent was, but which ingest cursor and which
+        # warm-start model the run was built from (DATA.md).
+        self._run_meta: dict | None = None
+
+    def set_run_meta(self, meta: dict | None) -> None:
+        """Attach run provenance (ingest cursor, init-model digest) to
+        every subsequent manifest commit. JSON-serializable values only;
+        None clears."""
+        self._run_meta = None if meta is None else dict(meta)
 
     def save(
         self,
@@ -215,6 +228,8 @@ class TrainingCheckpointer:
         manifest["file"] = fname
         manifest["sha256"] = digest
         manifest["written_at"] = time.time()
+        if self._run_meta is not None:
+            manifest["run"] = dict(self._run_meta)
         _atomic_write_json(
             os.path.join(self.directory, MANIFEST_FILE), manifest
         )

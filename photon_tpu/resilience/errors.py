@@ -27,6 +27,8 @@ the caller with enough context to act on.
 
 from __future__ import annotations
 
+import errno as _errno
+
 
 class TransientError(RuntimeError):
     """A failure expected to clear on retry (preemption, flaky RPC)."""
@@ -49,6 +51,19 @@ class CorruptModelError(RuntimeError):
     tracebacks (``zipfile.BadZipFile``, Avro struct errors); the message
     names the FILE and what failed so an operator can tell a truncated
     upload from a wrong path.
+    """
+
+
+class CorruptShardError(RuntimeError):
+    """A training DATA shard failed integrity or decode.
+
+    The data-path sibling of ``CorruptModelError``: raised by the
+    streaming ingest (``data/stream.py``) and the Avro data readers when
+    a shard's size/checksum/record count disagrees with the ingest
+    manifest or its container fails to decode. The message names the
+    FILE so an operator can quarantine or re-fetch exactly one shard —
+    never retried (bit rot is deterministic), but eligible for the
+    bounded-loss quarantine policy instead of aborting the whole run.
     """
 
 
@@ -126,6 +141,22 @@ TRANSIENT_ERROR_MARKERS: tuple[str, ...] = (
     "preempted",
 )
 
+# Filesystem/IO errnos that are expected to clear on retry: the
+# transient-media vocabulary of a network filesystem or a flaky disk
+# path mid-streaming-ingest. An EIO on a shard READ is worth one more
+# attempt before the shard is declared bad; deliberately absent are
+# ENOENT/EACCES/ENOSPC-style errnos, which are deterministic for the
+# retried call (a missing or unreadable shard does not reappear).
+TRANSIENT_ERRNOS: tuple[int, ...] = (
+    _errno.EIO,
+    _errno.EAGAIN,
+    _errno.EINTR,
+    _errno.ETIMEDOUT,
+    _errno.ECONNRESET,
+    _errno.ENETRESET,
+    _errno.ESTALE,
+)
+
 
 def is_transient(exc: BaseException) -> bool:
     """Classify a failure as expected-to-clear-on-retry.
@@ -147,6 +178,7 @@ def is_transient(exc: BaseException) -> bool:
             PoisonError,
             InjectedCrash,
             CorruptModelError,
+            CorruptShardError,
             CheckpointError,
             NonFiniteUpdateError,
             DeadlineExceededError,
@@ -157,6 +189,12 @@ def is_transient(exc: BaseException) -> bool:
     ):
         return False
     if isinstance(exc, ConnectionError):
+        return True
+    if isinstance(exc, OSError) and exc.errno in TRANSIENT_ERRNOS:
+        # EIO-style media blips (network fs, flaky disk path): the
+        # streaming ingest's shard read/decode sites retry these; a
+        # checksum mismatch after a CLEAN read is CorruptShardError
+        # (typed above) and never lands here.
         return True
     if isinstance(exc, (RuntimeError, OSError)):
         msg = str(exc)

@@ -27,6 +27,11 @@ point               boundary
                     BEFORE the atomic rename (the mid-write crash window)
 ``cd.iteration``    end of one outer CD iteration, AFTER its checkpoint
                     was written (the kill-and-resume window)
+``io.shard_read``   streaming-ingest shard READ (bytes + size/checksum
+                    verification against the ingest manifest,
+                    ``data/stream.py``)
+``io.shard_decode`` streaming-ingest shard DECODE (Avro container ->
+                    window arrays, ``data/stream.py``)
 ==================  ======================================================
 
 Fault kinds (``FaultSpec.error``): ``"transient"`` raises
@@ -90,6 +95,8 @@ INJECTION_POINTS = (
     "serve.dispatch",
     "checkpoint.write",
     "cd.iteration",
+    "io.shard_read",
+    "io.shard_decode",
 )
 
 _KINDS = ("transient", "poison", "crash", "delay", "sigterm")

@@ -121,12 +121,10 @@ def _build(kind: str, *, serial: bool):
         )
 
 
-@pytest.mark.parametrize("kind", FIXTURES)
-def test_parallel_planner_bit_identical_to_serial(kind):
-    """The determinism property: parallel planning produces byte-identical
-    packed buffers and identical BlockPlan metadata vs the serial path."""
-    a = _build(kind, serial=True)
-    b = _build(kind, serial=False)
+def _assert_same_packed(a, b):
+    """Byte-for-byte packed-buffer + BlockPlan equality — THE diff
+    harness shared by the serial-vs-parallel determinism tests and the
+    streaming kill-and-resume tests."""
     buf_a = np.asarray(a.packed_view.buffer)
     buf_b = np.asarray(b.packed_view.buffer)
     assert buf_a.dtype == buf_b.dtype == np.int32
@@ -146,6 +144,15 @@ def test_parallel_planner_bit_identical_to_serial(kind):
     np.testing.assert_array_equal(a.proj_all, b.proj_all)
     np.testing.assert_array_equal(a.sub_dims, b.sub_dims)
     assert a.max_sub_dim == b.max_sub_dim
+
+
+@pytest.mark.parametrize("kind", FIXTURES)
+def test_parallel_planner_bit_identical_to_serial(kind):
+    """The determinism property: parallel planning produces byte-identical
+    packed buffers and identical BlockPlan metadata vs the serial path."""
+    a = _build(kind, serial=True)
+    b = _build(kind, serial=False)
+    _assert_same_packed(a, b)
 
 
 # ---------------------------------------------------------------------------
@@ -477,3 +484,112 @@ def test_serial_env_flag_round_trips():
         assert pipeline.serial_ingest()
     with ingest_mode(serial=False):
         assert not pipeline.serial_ingest()
+
+
+# ---------------------------------------------------------------------------
+# streaming kill-and-resume determinism (photon_tpu.data.stream, PR 10)
+# ---------------------------------------------------------------------------
+
+
+STREAM_KINDS = ("cap", "sparse", "empty_entities")
+
+
+def _write_stream_fixture(kind: str, shard_dir: str):
+    """Avro-shard counterparts of the determinism matrix: dense-ish
+    rows under an active-data cap, sparse rows with exact zeros, and a
+    lower bound deactivating small entities. Returns the RE config."""
+    import os
+
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfiguration,
+    )
+    from photon_tpu.io.avro_data import write_training_examples
+    from photon_tpu.types import DELIMITER
+
+    os.makedirs(shard_dir, exist_ok=True)
+    rng = np.random.default_rng(11)
+    n_per, shards, d, e = 48, 5, 6, 13
+    kw: dict = {}
+    if kind == "cap":
+        kw = dict(active_data_upper_bound=6)
+    elif kind == "sparse":
+        kw = dict(active_data_upper_bound=7)
+    else:  # empty_entities
+        kw = dict(active_data_upper_bound=5, active_data_lower_bound=4)
+    base = 0
+    for si in range(shards):
+        y = rng.normal(size=n_per)
+        rows = []
+        for _ in range(n_per):
+            if kind == "cap":
+                feats = range(d)
+            else:
+                feats = rng.choice(d, size=3, replace=False)
+            row = [
+                (f"f{j}{DELIMITER}t", float(v))
+                for j in feats
+                if (v := rng.normal()) > -0.8 or kind == "cap"
+            ]
+            rows.append(row)
+        lo = 1 if kind == "empty_entities" else 0
+        meta = [
+            {"g": f"e{rng.integers(lo, e)}"} for _ in range(n_per)
+        ]
+        write_training_examples(
+            os.path.join(shard_dir, f"part-{si:05d}.avro"),
+            y, rows, metadata=meta, uids=np.arange(base, base + n_per),
+        )
+        base += n_per
+    return RandomEffectDataConfiguration("g", "features", **kw)
+
+
+@pytest.mark.parametrize("kind", STREAM_KINDS)
+def test_streaming_kill_resume_packed_buffers_byte_identical(
+    kind, tmp_path
+):
+    """The acceptance gate's determinism half: kill the streaming
+    ingest after shard k (crash-kind fault), resume from the cursor,
+    and the resumed dataset's PACKED PLAN BUFFERS are byte-for-byte
+    identical to the uninterrupted run's — across the cap / sparse /
+    empty-entity fixture matrix, through the same diff harness the
+    serial-vs-parallel determinism tests use."""
+    from photon_tpu.data.stream import StreamingIngest
+    from photon_tpu.io.avro_data import read_training_examples
+    from photon_tpu.resilience import FaultPlan, InjectedCrash, faults
+
+    shard_dir = str(tmp_path / "shards")
+    cfg = _write_stream_fixture(kind, shard_dir)
+    with ingest_mode(serial=True):
+        _, imap = read_training_examples(shard_dir)
+
+        def ingest(work, **kw):
+            return StreamingIngest(
+                shard_dir,
+                work_dir=str(tmp_path / work),
+                index_maps={"features": imap},
+                id_tag_names=["g"],
+                **kw,
+            )
+
+        full, _ = ingest("full").run()
+        with faults.injected(FaultPlan(
+            [dict(point="io.shard_read", nth=4, error="crash")]
+        )):
+            with pytest.raises(InjectedCrash):
+                ingest("killed").run()
+        resumed, stats = ingest("killed", resume=True).run()
+        assert stats["resumed_from_shard"] == 3
+        a = build_random_effect_dataset(full, cfg, intercept_index=None)
+        b = build_random_effect_dataset(
+            resumed, cfg, intercept_index=None
+        )
+    _assert_same_packed(a, b)
+    # The raw streamed columns are byte-identical too.
+    assert bytes(np.asarray(full.labels)) == bytes(
+        np.asarray(resumed.labels))
+    fa = full.feature_shards["features"]
+    fb = resumed.feature_shards["features"]
+    assert bytes(np.asarray(fa.values)) == bytes(np.asarray(fb.values))
+    np.testing.assert_array_equal(
+        np.asarray(full.id_tags["g"].codes),
+        np.asarray(resumed.id_tags["g"].codes))

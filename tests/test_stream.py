@@ -1,0 +1,760 @@
+"""Streaming ingest (photon_tpu.data.stream): manifest integrity,
+corrupt-shard quarantine, transient-I/O retry, cursor resume, and the
+warm-start day-over-day retrain surface (DATA.md).
+
+The cursor-resume PACKED-BUFFER byte-diff (the PR-3 determinism harness
+applied to kill-and-resume streaming) lives in
+tests/test_ingest_pipeline.py next to the harness it reuses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.stream import (
+    CURSOR_FILE,
+    MANIFEST_FILE,
+    QuarantinePolicy,
+    StreamingIngest,
+    build_shard_manifest,
+)
+from photon_tpu.io.avro_data import (
+    checked_iter_container_dir,
+    read_training_examples,
+    write_training_examples,
+)
+from photon_tpu.resilience import (
+    FaultPlan,
+    InjectedCrash,
+    faults,
+    reset_retry_stats,
+    retry_stats,
+)
+from photon_tpu.resilience.errors import (
+    CorruptShardError,
+    ResumeMismatchError,
+    TransientError,
+    is_transient,
+)
+from photon_tpu.types import DELIMITER
+
+
+N_PER_SHARD = 40
+N_SHARDS = 5
+D = 4
+E = 7
+
+
+def _write_shards(shard_dir, *, n_per=N_PER_SHARD, shards=N_SHARDS,
+                  d=D, e=E, seed=3):
+    os.makedirs(shard_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    base = 0
+    for si in range(shards):
+        y = rng.normal(size=n_per)
+        rows = [
+            [(f"f{j}{DELIMITER}t", float(rng.normal()))
+             for j in rng.choice(d, size=3, replace=False)]
+            for _ in range(n_per)
+        ]
+        meta = [{"userId": f"u{rng.integers(0, e)}"} for _ in range(n_per)]
+        write_training_examples(
+            os.path.join(shard_dir, f"part-{si:05d}.avro"),
+            y, rows, metadata=meta, uids=np.arange(base, base + n_per),
+        )
+        base += n_per
+    return shard_dir
+
+
+@pytest.fixture()
+def shard_dir(tmp_path):
+    return _write_shards(str(tmp_path / "shards"))
+
+
+def _ingest(shard_dir, work_dir, **kw):
+    kw.setdefault("id_tag_names", ["userId"])
+    return StreamingIngest(shard_dir, work_dir=str(work_dir), **kw)
+
+
+def _assert_datasets_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(b.labels))
+    np.testing.assert_array_equal(
+        np.asarray(a.offsets), np.asarray(b.offsets))
+    np.testing.assert_array_equal(
+        np.asarray(a.weights), np.asarray(b.weights))
+    fa, fb = a.feature_shards["features"], b.feature_shards["features"]
+    assert bytes(np.asarray(fa.indices)) == bytes(np.asarray(fb.indices))
+    assert bytes(np.asarray(fa.values)) == bytes(np.asarray(fb.values))
+    assert fa.d == fb.d
+    assert set(a.id_tags) == set(b.id_tags)
+    for t in a.id_tags:
+        np.testing.assert_array_equal(
+            np.asarray(a.id_tags[t].codes), np.asarray(b.id_tags[t].codes))
+        assert a.id_tags[t].inverse == b.id_tags[t].inverse
+    np.testing.assert_array_equal(a.uids, b.uids)
+    ia, va, da = a.host_shard_coo("features")
+    ib, vb, db = b.host_shard_coo("features")
+    assert bytes(ia) == bytes(ib) and bytes(va) == bytes(vb) and da == db
+
+
+class TestManifest:
+    def test_build_records_size_hash_count_offset(self, shard_dir):
+        manifest = build_shard_manifest(shard_dir)
+        assert len(manifest["shards"]) == N_SHARDS
+        offset = 0
+        for info in manifest["shards"]:
+            path = os.path.join(shard_dir, info["name"])
+            assert info["size"] == os.path.getsize(path)
+            assert len(info["sha256"]) == 64
+            assert info["records"] == N_PER_SHARD
+            assert info["row_offset"] == offset
+            offset += info["records"]
+
+    def test_run_commits_manifest_and_cursor(self, shard_dir, tmp_path):
+        work = tmp_path / "work"
+        _ingest(shard_dir, work).run()
+        assert (work / MANIFEST_FILE).is_file()
+        cursor = json.loads((work / CURSOR_FILE).read_text())
+        assert cursor["next_shard"] == N_SHARDS
+        assert cursor["rows_ingested"] == N_PER_SHARD * N_SHARDS
+        assert cursor["quarantined"] == {}
+
+    def test_unscannable_shard_records_none(self, shard_dir):
+        p = os.path.join(shard_dir, "part-00001.avro")
+        with open(p, "wb") as f:
+            f.write(b"Obj\x01garbage")
+        manifest = build_shard_manifest(shard_dir)
+        assert manifest["shards"][1]["records"] is None
+
+
+class TestStreamedEqualsInMemory:
+    @pytest.mark.parametrize("window_shards", [1, 2, N_SHARDS])
+    def test_equality(self, shard_dir, tmp_path, window_shards):
+        mem, imap = read_training_examples(shard_dir)
+        ds, stats = _ingest(
+            shard_dir, tmp_path / f"w{window_shards}",
+            index_maps={"features": imap},
+            window_shards=window_shards,
+        ).run()
+        _assert_datasets_equal(mem, ds)
+        assert stats["ingested_fraction"] == 1.0
+        assert stats["shards_quarantined"] == 0
+        assert stats["rows_ingested"] == mem.num_samples
+
+    def test_scanned_vocab_matches_in_memory(self, shard_dir, tmp_path):
+        """No prebuilt maps: the streamed scan pass derives the same
+        vocabulary + auto tag names as the in-memory reader."""
+        mem, imap = read_training_examples(shard_dir)
+        ing = _ingest(shard_dir, tmp_path / "scan", id_tag_names=None)
+        ds, _ = ing.run()
+        assert dict(ing.resolved_maps["features"].items()) == dict(
+            imap.items())
+        assert ing.id_tag_names == ["userId"]
+        _assert_datasets_equal(mem, ds)
+
+
+class TestCorruptShards:
+    def test_truncated_data_shard_raises_typed_error_naming_file(
+        self, shard_dir
+    ):
+        """Satellite: a truncated real DATA shard surfaces as a typed
+        error naming the exact part file (PR 7 covered model artifacts
+        only)."""
+        p = os.path.join(shard_dir, "part-00002.avro")
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        with pytest.raises(CorruptShardError, match="part-00002.avro"):
+            list(checked_iter_container_dir(shard_dir))
+        # ...and the in-memory reader reports the same typed error.
+        with pytest.raises(CorruptShardError, match="part-00002.avro"):
+            read_training_examples(shard_dir)
+
+    def test_default_policy_aborts_on_first_corrupt_shard(
+        self, shard_dir, tmp_path
+    ):
+        _, imap = read_training_examples(shard_dir)
+        p = os.path.join(shard_dir, "part-00001.avro")
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[: len(raw) - 30])
+        with pytest.raises(CorruptShardError, match="part-00001.avro"):
+            _ingest(
+                shard_dir, tmp_path / "abort",
+                index_maps={"features": imap},
+            ).run()
+
+    def test_checksum_mismatch_after_manifest_is_corruption(
+        self, shard_dir, tmp_path, serial_ingest_env
+    ):
+        """Bit rot AFTER the manifest commit: same size, different
+        bytes — caught by the manifest checksum at READ time (the
+        decoder might even accept the bytes), naming the file. The rot
+        lands on a shard the killed run never reached, so the resumed
+        run must actually re-read it."""
+        _, imap = read_training_examples(shard_dir)
+        work = tmp_path / "rot"
+        with faults.injected(FaultPlan(
+            [dict(point="io.shard_read", nth=3, error="crash")]
+        )):
+            with pytest.raises(InjectedCrash):
+                _ingest(
+                    shard_dir, work, index_maps={"features": imap}
+                ).run()
+        p = os.path.join(shard_dir, "part-00003.avro")
+        raw = bytearray(open(p, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(p, "wb") as f:
+            f.write(bytes(raw))
+        with pytest.raises(
+            CorruptShardError, match="checksum mismatch"
+        ) as exc_info:
+            _ingest(
+                shard_dir, work, index_maps={"features": imap},
+                resume=True,
+            ).run()
+        assert "part-00003.avro" in str(exc_info.value)
+
+    def test_quarantine_skips_counts_and_surfaces(
+        self, shard_dir, tmp_path
+    ):
+        _, imap = read_training_examples(shard_dir)
+        p = os.path.join(shard_dir, "part-00002.avro")
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        ds, stats = _ingest(
+            shard_dir, tmp_path / "q",
+            index_maps={"features": imap},
+            quarantine=QuarantinePolicy(max_bad_fraction=0.25),
+        ).run()
+        assert stats["shards_quarantined"] == 1
+        assert stats["quarantined_paths"] == [p]
+        assert stats["rows_ingested"] == N_PER_SHARD * (N_SHARDS - 1)
+        assert 0.0 < stats["ingested_fraction"] < 1.0
+        assert ds.num_samples == stats["rows_ingested"]
+        # Health surface: the registry gauges carry the degradation.
+        from photon_tpu import obs
+
+        gauges = obs.REGISTRY.snapshot()["gauges"]
+        assert gauges.get("stream_ingested_fraction") == stats[
+            "ingested_fraction"]
+        assert gauges.get("stream_quarantined_shards") == 1
+
+    def test_quarantine_budget_exceeded_aborts(self, shard_dir, tmp_path):
+        _, imap = read_training_examples(shard_dir)
+        for name in ("part-00001.avro", "part-00003.avro"):
+            p = os.path.join(shard_dir, name)
+            raw = open(p, "rb").read()
+            with open(p, "wb") as f:
+                f.write(raw[: len(raw) // 2])
+        with pytest.raises(CorruptShardError):
+            _ingest(
+                shard_dir, tmp_path / "over",
+                index_maps={"features": imap},
+                quarantine=QuarantinePolicy(max_bad_shards=1),
+            ).run()
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            QuarantinePolicy(max_bad_shards=-1)
+        with pytest.raises(ValueError):
+            QuarantinePolicy(max_bad_fraction=1.5)
+        assert QuarantinePolicy(max_bad_fraction=0.5).budget(10) == 5
+        assert QuarantinePolicy(max_bad_shards=3).budget(10) == 3
+
+
+class TestTransientRetry:
+    def test_eio_is_transient_checksum_is_not(self):
+        import errno
+
+        assert is_transient(OSError(errno.EIO, "Input/output error"))
+        assert is_transient(OSError(errno.ESTALE, "Stale file handle"))
+        assert not is_transient(OSError(errno.ENOENT, "No such file"))
+        assert not is_transient(CorruptShardError("bad shard"))
+
+    def test_injected_transients_retried_to_success(
+        self, shard_dir, tmp_path, serial_ingest_env
+    ):
+        _, imap = read_training_examples(shard_dir)
+        reset_retry_stats()
+        plan = FaultPlan([
+            dict(point="io.shard_read", nth=1),
+            dict(point="io.shard_decode", nth=1),
+        ], seed=7)
+        with faults.injected(plan):
+            ds, stats = _ingest(
+                shard_dir, tmp_path / "retry",
+                index_maps={"features": imap},
+            ).run()
+            fired = faults.fired()
+        assert len(fired) == 2
+        s = retry_stats()
+        assert s["retries"] == 2 and s["exhausted"] == 0
+        assert s["recovered"] >= 1
+        assert stats["ingested_fraction"] == 1.0
+        # ...and a clean rerun records ZERO retries.
+        reset_retry_stats()
+        _ingest(
+            shard_dir, tmp_path / "clean", index_maps={"features": imap}
+        ).run()
+        assert retry_stats() == {
+            "retries": 0, "recovered": 0, "exhausted": 0,
+            "backoff_seconds": 0.0,
+        }
+
+    def test_exhausted_transients_propagate(
+        self, shard_dir, tmp_path, serial_ingest_env
+    ):
+        _, imap = read_training_examples(shard_dir)
+        reset_retry_stats()
+        plan = FaultPlan([
+            dict(point="io.shard_read", nth=n) for n in (1, 2, 3)
+        ])
+        with faults.injected(plan):
+            with pytest.raises(TransientError):
+                _ingest(
+                    shard_dir, tmp_path / "exhaust",
+                    index_maps={"features": imap},
+                ).run()
+        assert retry_stats()["exhausted"] == 1
+        reset_retry_stats()
+
+
+@pytest.fixture()
+def serial_ingest_env(monkeypatch):
+    """Inline window decode: deterministic nth-call fault accounting
+    (the prefetch worker would otherwise interleave per-point call
+    counts across windows)."""
+    monkeypatch.setenv("PHOTON_TPU_SERIAL_INGEST", "1")
+    from photon_tpu.data import pipeline
+
+    pipeline.reset_executors()
+    yield
+    monkeypatch.delenv("PHOTON_TPU_SERIAL_INGEST", raising=False)
+    pipeline.reset_executors()
+
+
+class TestCursorResume:
+    def test_kill_and_resume_is_byte_identical(
+        self, shard_dir, tmp_path, serial_ingest_env
+    ):
+        _, imap = read_training_examples(shard_dir)
+        full, _ = _ingest(
+            shard_dir, tmp_path / "full", index_maps={"features": imap}
+        ).run()
+        work = tmp_path / "killed"
+        with faults.injected(FaultPlan(
+            [dict(point="io.shard_read", nth=3, error="crash")]
+        )):
+            with pytest.raises(InjectedCrash):
+                _ingest(
+                    shard_dir, work, index_maps={"features": imap}
+                ).run()
+        cursor = json.loads((work / CURSOR_FILE).read_text())
+        assert 0 < cursor["next_shard"] < N_SHARDS
+        resumed, stats = _ingest(
+            shard_dir, work, index_maps={"features": imap}, resume=True
+        ).run()
+        assert stats["resumed_from_shard"] == cursor["next_shard"]
+        _assert_datasets_equal(full, resumed)
+
+    def test_resume_without_cursor_refuses(self, shard_dir, tmp_path):
+        with pytest.raises(ResumeMismatchError, match="nothing to resume"):
+            _ingest(
+                shard_dir, tmp_path / "none", resume=True
+            ).run()
+
+    def test_resume_under_changed_config_refuses(
+        self, shard_dir, tmp_path, serial_ingest_env
+    ):
+        _, imap = read_training_examples(shard_dir)
+        work = tmp_path / "cfg"
+        with faults.injected(FaultPlan(
+            [dict(point="io.shard_read", nth=3, error="crash")]
+        )):
+            with pytest.raises(InjectedCrash):
+                _ingest(
+                    shard_dir, work, index_maps={"features": imap},
+                    window_shards=1,
+                ).run()
+        with pytest.raises(ResumeMismatchError):
+            _ingest(
+                shard_dir, work, index_maps={"features": imap},
+                window_shards=2, resume=True,
+            ).run()
+
+    def test_resume_after_data_change_refuses(
+        self, shard_dir, tmp_path, serial_ingest_env
+    ):
+        """The cursor pins the manifest; a shard rewritten between the
+        kill and the resume fails the checksum, not silently mixes."""
+        _, imap = read_training_examples(shard_dir)
+        work = tmp_path / "mix"
+        with faults.injected(FaultPlan(
+            [dict(point="io.shard_read", nth=3, error="crash")]
+        )):
+            with pytest.raises(InjectedCrash):
+                _ingest(
+                    shard_dir, work, index_maps={"features": imap}
+                ).run()
+        # Rewrite a not-yet-ingested shard with different contents.
+        p = os.path.join(shard_dir, "part-00004.avro")
+        write_training_examples(
+            p, np.ones(3), [[(f"f0{DELIMITER}t", 1.0)]] * 3,
+            metadata=[{"userId": "u0"}] * 3, uids=np.arange(3),
+        )
+        with pytest.raises(CorruptShardError, match="part-00004.avro"):
+            _ingest(
+                shard_dir, work, index_maps={"features": imap},
+                resume=True,
+            ).run()
+
+    def test_resume_under_substituted_same_size_vocab_refuses(
+        self, shard_dir, tmp_path, serial_ingest_env
+    ):
+        """A regenerated vocabulary of the SAME size but a different
+        key->index assignment must fail the resume config check — size
+        alone would silently mix feature mappings across the resume
+        boundary."""
+        from photon_tpu.data.index_map import IndexMap
+
+        _, imap = read_training_examples(shard_dir)
+        work = tmp_path / "vocab"
+        with faults.injected(FaultPlan(
+            [dict(point="io.shard_read", nth=3, error="crash")]
+        )):
+            with pytest.raises(InjectedCrash):
+                _ingest(
+                    shard_dir, work, index_maps={"features": imap}
+                ).run()
+        # Same length, same intercept position, permuted assignment.
+        keys = [k for k, _ in sorted(imap.items(), key=lambda kv: kv[1])]
+        permuted = IndexMap({
+            k: i for i, k in enumerate(keys[1:-1][::-1] + [keys[0]])
+            } | {keys[-1]: len(keys) - 1})
+        assert len(permuted) == len(imap)
+        assert permuted.intercept_index == imap.intercept_index
+        with pytest.raises(ResumeMismatchError):
+            _ingest(
+                shard_dir, work, index_maps={"features": permuted},
+                resume=True,
+            ).run()
+
+    def test_resume_under_tighter_quarantine_budget_refuses(
+        self, shard_dir, tmp_path
+    ):
+        """A completed cursor carrying quarantined shards must not
+        resume under a policy that would never have allowed the loss."""
+        _, imap = read_training_examples(shard_dir)
+        p = os.path.join(shard_dir, "part-00002.avro")
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        work = tmp_path / "tight"
+        _ingest(
+            shard_dir, work, index_maps={"features": imap},
+            quarantine=QuarantinePolicy(max_bad_fraction=0.25),
+        ).run()
+        with pytest.raises(CorruptShardError, match="current policy"):
+            _ingest(
+                shard_dir, work, index_maps={"features": imap},
+                resume=True,
+            ).run()
+
+    def test_fresh_run_rescans_after_shard_repair(
+        self, shard_dir, tmp_path
+    ):
+        """An operator who repairs a quarantined shard and reruns a
+        FRESH ingest in the same work dir gets its rows back — the
+        committed vocab artifact's stale quarantine set must not
+        silently exclude a now-healthy file."""
+        p = os.path.join(shard_dir, "part-00002.avro")
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        work = tmp_path / "repair"
+        # Scanned vocab (no prebuilt maps) so the artifact records the
+        # quarantine set.
+        _, stats = _ingest(
+            shard_dir, work, id_tag_names=None,
+            quarantine=QuarantinePolicy(max_bad_fraction=0.25),
+        ).run()
+        assert stats["shards_quarantined"] == 1
+        with open(p, "wb") as f:
+            f.write(raw)  # repair
+        _, stats2 = _ingest(
+            shard_dir, work, id_tag_names=None,
+            quarantine=QuarantinePolicy(max_bad_fraction=0.25),
+        ).run()
+        assert stats2["shards_quarantined"] == 0
+        assert stats2["ingested_fraction"] == 1.0
+        assert stats2["rows_ingested"] == N_PER_SHARD * N_SHARDS
+
+    def test_missing_response_field_is_typed_and_quarantinable(
+        self, shard_dir, tmp_path
+    ):
+        """Schema drift in ONE shard (records without the response
+        field) names the file and stays eligible for the quarantine
+        policy instead of aborting with a bare KeyError."""
+        from photon_tpu.io import avro
+        from photon_tpu.io.avro_data import RESPONSE_PREDICTION_SCHEMA
+
+        _, imap = read_training_examples(shard_dir)
+        p = os.path.join(shard_dir, "part-00001.avro")
+        avro.write_container(p, RESPONSE_PREDICTION_SCHEMA, [{
+            "response": 1.0,
+            "features": [{"name": "f0", "term": "t", "value": 1.0}],
+            "weight": 1.0, "offset": 0.0,
+        }])
+        with pytest.raises(
+            CorruptShardError, match="part-00001.avro.*response"
+        ):
+            _ingest(
+                shard_dir, tmp_path / "drift",
+                index_maps={"features": imap},
+                response_field="label",
+            ).run()
+        _, stats = _ingest(
+            shard_dir, tmp_path / "drift2",
+            index_maps={"features": imap}, response_field="label",
+            quarantine=QuarantinePolicy(max_bad_shards=1),
+        ).run()
+        assert stats["shards_quarantined"] == 1
+
+    def test_resume_of_completed_ingest_reloads_spills(
+        self, shard_dir, tmp_path
+    ):
+        _, imap = read_training_examples(shard_dir)
+        work = tmp_path / "done"
+        first, _ = _ingest(
+            shard_dir, work, index_maps={"features": imap}
+        ).run()
+        again, stats = _ingest(
+            shard_dir, work, index_maps={"features": imap}, resume=True
+        ).run()
+        assert stats["resumed_from_shard"] == N_SHARDS
+        _assert_datasets_equal(first, again)
+
+
+class TestWarmStart:
+    def _estimator(self):
+        from photon_tpu import optim
+        from photon_tpu.algorithm.problems import (
+            GLMOptimizationConfiguration,
+        )
+        from photon_tpu.data.random_effect import (
+            RandomEffectDataConfiguration,
+        )
+        from photon_tpu.estimators.game_estimator import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+            RandomEffectCoordinateConfiguration,
+        )
+        from photon_tpu.types import TaskType
+
+        def l2(w):
+            return GLMOptimizationConfiguration(
+                regularization=optim.RegularizationContext(
+                    optim.RegularizationType.L2),
+                regularization_weight=w,
+            )
+
+        return GameEstimator(
+            TaskType.LINEAR_REGRESSION,
+            {
+                "global": FixedEffectCoordinateConfiguration(
+                    "features", l2(0.01)),
+                "per-user": RandomEffectCoordinateConfiguration(
+                    RandomEffectDataConfiguration("userId", "features"),
+                    l2(0.5)),
+            },
+            num_iterations=2,
+            mesh="off",
+        )
+
+    def test_fit_init_model_path_matches_loaded_model(
+        self, shard_dir, tmp_path
+    ):
+        from photon_tpu.io.model_io import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        _, imap = read_training_examples(shard_dir)
+        day1, _ = _ingest(
+            shard_dir, tmp_path / "d1", index_maps={"features": imap}
+        ).run()
+        model1 = self._estimator().fit(day1)[0].model
+        ckpt = str(tmp_path / "day1.npz")
+        save_checkpoint(model1, ckpt)
+
+        day2, _ = _ingest(
+            shard_dir, tmp_path / "d2", index_maps={"features": imap}
+        ).run()
+        by_path = self._estimator().fit(day2, init_model=ckpt)[0].model
+        by_model = self._estimator().fit(
+            day2, initial_model=load_checkpoint(ckpt)
+        )[0].model
+        np.testing.assert_array_equal(
+            np.asarray(by_path["global"].model.coefficients.means),
+            np.asarray(by_model["global"].model.coefficients.means))
+        np.testing.assert_array_equal(
+            np.asarray(by_path["per-user"].coefficients),
+            np.asarray(by_model["per-user"].coefficients))
+
+    def test_fit_rejects_both_warm_start_forms(self, shard_dir, tmp_path):
+        _, imap = read_training_examples(shard_dir)
+        day1, _ = _ingest(
+            shard_dir, tmp_path / "both", index_maps={"features": imap}
+        ).run()
+        est = self._estimator()
+        model = est.fit(day1)[0].model
+        with pytest.raises(ValueError, match="exactly one"):
+            self._estimator().fit(
+                day1, initial_model=model, init_model=model)
+
+    def test_artifact_digest_stability(self, tmp_path):
+        from photon_tpu.io.model_io import artifact_digest
+
+        f = tmp_path / "a.npz"
+        f.write_bytes(b"hello")
+        assert artifact_digest(str(f)) == artifact_digest(str(f))
+        d = tmp_path / "model"
+        (d / "sub").mkdir(parents=True)
+        (d / "x").write_bytes(b"1")
+        (d / "sub" / "y").write_bytes(b"2")
+        d1 = artifact_digest(str(d))
+        (d / "x").write_bytes(b"changed")
+        assert artifact_digest(str(d)) != d1
+
+    def test_load_initial_model_dir_requires_maps(self, tmp_path):
+        from photon_tpu.io.model_io import (
+            METADATA_FILE,
+            load_initial_model,
+        )
+
+        d = tmp_path / "avmodel"
+        d.mkdir()
+        (d / METADATA_FILE).write_text("{}")
+        with pytest.raises(ValueError, match="index maps"):
+            load_initial_model(str(d))
+        with pytest.raises(FileNotFoundError):
+            load_initial_model(str(tmp_path / "missing"))
+
+
+class TestCLI:
+    def _config(self, tmp_path):
+        cfg = {
+            "task": "LINEAR_REGRESSION",
+            "input": {
+                "format": "avro",
+                "train_path": "unused-under-stream-dir",
+                "id_tags": ["userId"],
+            },
+            "coordinates": {
+                "global": {
+                    "type": "fixed",
+                    "regularization": {"type": "L2", "weights": [0.01]},
+                },
+                "per-user": {
+                    "type": "random",
+                    "random_effect_type": "userId",
+                    "regularization": {"type": "L2", "weights": [0.5]},
+                },
+            },
+            "num_iterations": 2,
+            "output_dir": str(tmp_path / "out"),
+        }
+        path = tmp_path / "cfg.json"
+        path.write_text(json.dumps(cfg))
+        return str(path)
+
+    def test_stream_train_end_to_end_with_provenance(
+        self, shard_dir, tmp_path
+    ):
+        from photon_tpu.cli.train import main as train_main
+
+        cfg = self._config(tmp_path)
+        ckpt = str(tmp_path / "ckpt")
+        assert train_main([
+            "--config", cfg, "--stream-dir", shard_dir,
+            "--checkpoint-dir", ckpt, "--stream-window", "2",
+        ]) == 0
+        summary = json.loads(
+            (tmp_path / "out" / "training-summary.json").read_text())
+        si = summary["streaming_ingest"]
+        assert si["ingested_fraction"] == 1.0
+        assert si["rows_ingested"] == N_PER_SHARD * N_SHARDS
+        manifest = json.loads(
+            (tmp_path / "ckpt" / "manifest.json").read_text())
+        cursor_meta = manifest["run"]["ingest_cursor"]
+        assert cursor_meta["manifest_sha256"] == si["manifest_sha256"]
+        assert cursor_meta["rows_ingested"] == si["rows_ingested"]
+
+        # Day 2: warm-start from the saved checkpoint, resume the
+        # completed ingest from its cursor (spill reloads).
+        init = str(tmp_path / "out" / "models" / "best" / "checkpoint.npz")
+        assert train_main([
+            "--config", cfg, "--stream-dir", shard_dir,
+            "--checkpoint-dir", ckpt, "--stream-window", "2",
+            "--resume-ingest", "--init-model", init,
+        ]) == 0
+        manifest = json.loads(
+            (tmp_path / "ckpt" / "manifest.json").read_text())
+        assert "init_model" in manifest["run"]
+        assert len(manifest["run"]["init_model"]["sha256"]) == 64
+        summary = json.loads(
+            (tmp_path / "out" / "training-summary.json").read_text())
+        assert summary["streaming_ingest"]["resumed_from_shard"] \
+            == N_SHARDS
+
+    def test_quarantine_run_reports_degraded_fraction(
+        self, shard_dir, tmp_path
+    ):
+        from photon_tpu.cli.train import main as train_main
+
+        p = os.path.join(shard_dir, "part-00001.avro")
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        cfg = self._config(tmp_path)
+        assert train_main([
+            "--config", cfg, "--stream-dir", shard_dir,
+            "--max-bad-fraction", "0.25",
+        ]) == 0
+        summary = json.loads(
+            (tmp_path / "out" / "training-summary.json").read_text())
+        si = summary["streaming_ingest"]
+        assert si["ingested_fraction"] < 1.0
+        assert si["shards_quarantined"] == 1
+        assert si["quarantined_paths"] == [p]
+
+    def test_resume_ingest_requires_stream_dir(self, tmp_path):
+        from photon_tpu.cli.train import main as train_main
+
+        with pytest.raises(SystemExit):
+            train_main([
+                "--config", self._config(tmp_path), "--resume-ingest",
+            ])
+
+
+def test_streaming_contract_gates_clean():
+    """The tier-2 streaming-ingest contract on the canonical fixture:
+    streamed windows trace byte-identical fused programs to in-memory
+    ingest and the audit reports zero findings."""
+    from photon_tpu.analysis import program
+
+    contracts = [
+        c for c in program.collect_contracts()
+        if c.name == "streaming-ingest"
+    ]
+    assert contracts, "streaming-ingest contract missing from registry"
+    findings, report = program.audit(contracts, with_cost=False)
+    assert [f for f in findings if not f.suppressed] == []
+    entry = report["contracts"]["streaming-ingest"]
+    assert set(entry["programs"]) == {"materialize", "fit"}
