@@ -179,6 +179,20 @@ STREAM_FEATURES = 8
 STREAM_USERS = 2_000
 STREAM_WINDOW_SHARDS = 2
 
+# Pilot scenario sizing (photon_tpu.pilot; PILOT.md): a multi-"day"
+# replay of the production control loop — day 1 bootstraps a serving
+# generation, each later day drops a shard and the pilot ingests →
+# warm-start retrains → gates → hot-reloads the LIVE queue while a
+# traffic thread scores against it continuously. Measured: staleness
+# (shard-landed → model-serving seconds), promotions, and the two
+# zero-gates (reload compile events, dropped/errored requests).
+PILOT_DAYS = 4
+PILOT_USERS = 16
+PILOT_FEATURES = 6
+PILOT_ROWS_PER_USER_DAY = 24
+PILOT_TRAFFIC_QPS = 250.0
+PILOT_RUNGS = (1, 8, 32)
+
 YAHOO_TRAIN = (
     "/root/reference/photon-client/src/integTest/resources/GameIntegTest/"
     "input/duplicateFeatures/yahoo-music-train.avro"
@@ -907,6 +921,238 @@ def streaming_regressions(streaming: dict) -> list[str]:
     return out
 
 
+def _write_pilot_day(shard_dir: str, day: int, rng) -> None:
+    """One day's shard. Day 0 SATURATES every user's feature support
+    (fixed triples covering all PILOT_FEATURES features) so later
+    retrains keep the random-effect projector — and therefore the
+    compiled score ladder — byte-identical: the pinned-vocabulary
+    values-only steady state the zero-recompile gate measures. Later
+    days draw features at random from the same universe."""
+    from photon_tpu.io.avro_data import write_training_examples
+    from photon_tpu.types import DELIMITER
+
+    os.makedirs(shard_dir, exist_ok=True)
+    cover = [[0, 1, 2], [3, 4, 5], [0, 3, 5], [1, 2, 4]]
+    rows, y, meta = [], [], []
+    for u in range(PILOT_USERS):
+        for r in range(PILOT_ROWS_PER_USER_DAY):
+            if day == 0 and r < len(cover):
+                fs = cover[r]
+            else:
+                fs = list(rng.choice(PILOT_FEATURES, size=3,
+                                     replace=False))
+            vals = rng.normal(size=len(fs))
+            rows.append([
+                (f"f{j}{DELIMITER}t", float(v))
+                for j, v in zip(fs, vals)
+            ])
+            z = float(vals.sum()) * 0.5
+            y.append(float(rng.uniform() < 1.0 / (1.0 + np.exp(-z))))
+            meta.append({"userId": f"u{u}"})
+    write_training_examples(
+        os.path.join(shard_dir, f"part-{day:03d}.avro"),
+        np.array(y), rows, metadata=meta,
+    )
+
+
+def _pilot_traffic(pilot, rate: float, stop, counts: dict) -> None:
+    """Closed-loop synthetic traffic against whatever generation is
+    live — every promotion in the replay happens UNDER load, which is
+    what makes the zero-dropped-requests number evidence rather than
+    vacuously true. The loop is the shared
+    ``serve.driver.traffic_loop`` (same generator the pilot CLI's
+    ``--traffic-qps`` runs); the counter dict is this thread's, read
+    after the join."""
+    from photon_tpu.serve.driver import traffic_loop
+
+    traffic_loop(
+        lambda: pilot.server, rate, stop, counts,
+        batch=32, idle_sleep=0.01,
+    )
+
+
+def run_pilot() -> dict:
+    """The `pilot` scenario: the production control loop replayed over
+    PILOT_DAYS "days" (photon_tpu.pilot; PILOT.md).
+
+    Day 0 bootstraps generation 1 and starts the live queue; each later
+    day drops one shard and the pilot runs a full
+    ingest→train→validate→promote→observe cycle while the traffic
+    thread keeps scoring. Reported: staleness per drop (shard-landed →
+    model-serving seconds, max + mean), promotions, and the scenario's
+    two zero-gates — serving reload compile events (values-only
+    promotions must add NO programs; the tier-2 ``pilot`` contract is
+    the static half) and dropped/errored requests across every
+    promotion."""
+    import shutil
+    import tempfile
+    import threading
+
+    from photon_tpu.pilot import (
+        ObservePolicy,
+        Pilot,
+        PilotConfig,
+        PilotServer,
+        PromotionGate,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="photon_pilot_bench")
+    try:
+        shard_dir = os.path.join(tmp, "shards")
+        rng = np.random.default_rng(20260804)
+        _write_pilot_day(shard_dir, 0, rng)
+
+        cfg = PilotConfig(
+            stream_dir=shard_dir,
+            work_dir=os.path.join(tmp, "work"),
+            estimator_factory=_pilot_estimator,
+            keep_generations=3,
+            # The replay benches the MECHANISM (a tiny synthetic model
+            # retrained on near-identical data wobbles either way), so
+            # the gate grants a wide regression allowance; the gate's
+            # refusal path is exercised by chaos CI, not here.
+            gate=PromotionGate(min_delta={"AUC": -1.0}),
+            observe=ObservePolicy(window_s=0.2, poll_s=0.05),
+        )
+        pilot = Pilot(cfg, server_factory=lambda m: PilotServer(
+            m, rungs=PILOT_RUNGS, max_linger_s=0.001,
+        ))
+        t0 = time.perf_counter()
+        boot = pilot.run_cycle()
+        boot_seconds = time.perf_counter() - t0
+        if "error" in boot:
+            raise RuntimeError(
+                f"pilot bootstrap cycle failed: {boot['error']}")
+
+        stop = threading.Event()
+        counts = {
+            "served": 0, "errors": 0, "submit_errors": 0,
+            "stranded": 0, "last_error": None,
+        }
+        traffic = threading.Thread(
+            target=_pilot_traffic,
+            args=(pilot, PILOT_TRAFFIC_QPS, stop, counts),
+            name="pilot-bench-traffic", daemon=True,
+        )
+        traffic.start()
+        staleness = []
+        cycle_seconds = []
+        try:
+            for day in range(1, PILOT_DAYS):
+                _write_pilot_day(shard_dir, day, rng)
+                t0 = time.perf_counter()
+                report = pilot.run_cycle()
+                cycle_seconds.append(time.perf_counter() - t0)
+                if "error" in report:
+                    raise RuntimeError(
+                        f"pilot day-{day} cycle failed at stage "
+                        f"{report['stage']}: {report['error']}")
+                if report.get("staleness_seconds") is not None:
+                    staleness.append(report["staleness_seconds"])
+        finally:
+            stop.set()
+            traffic.join(timeout=60.0)
+        health = pilot.server.health()
+        reload_events = pilot.server.reload_compile_events
+        pilot.server.close(timeout=30.0)
+
+        return {
+            "pilot_days": PILOT_DAYS,
+            "pilot_rows_per_day": PILOT_USERS * PILOT_ROWS_PER_USER_DAY,
+            "pilot_users": PILOT_USERS,
+            "pilot_promotions": pilot.state.promotions,
+            "pilot_rollbacks": pilot.state.rollbacks,
+            "pilot_refusals": pilot.state.refusals,
+            "pilot_bootstrap_seconds": round(boot_seconds, 3),
+            "pilot_cycle_seconds_mean": round(
+                sum(cycle_seconds) / len(cycle_seconds), 3
+            ) if cycle_seconds else None,
+            "pilot_staleness_seconds": (
+                round(max(staleness), 3) if staleness else None
+            ),
+            "pilot_staleness_mean_seconds": (
+                round(sum(staleness) / len(staleness), 3)
+                if staleness else None
+            ),
+            "pilot_serving_compile_events": reload_events,
+            "pilot_requests_served": counts["served"],
+            "pilot_request_errors": (
+                counts["errors"] + counts["submit_errors"]
+                + counts["stranded"]
+            ),
+            "pilot_traffic_qps_offered": PILOT_TRAFFIC_QPS,
+            "pilot_breaker_trips": health["breaker_trips"],
+            "pilot_generation_live": pilot.ring.live,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _pilot_estimator():
+    from photon_tpu import optim
+    from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
+    from photon_tpu.data.random_effect import RandomEffectDataConfiguration
+    from photon_tpu.estimators.game_estimator import (
+        FixedEffectCoordinateConfiguration,
+        GameEstimator,
+        RandomEffectCoordinateConfiguration,
+    )
+    from photon_tpu.types import TaskType
+
+    def l2(w):
+        return GLMOptimizationConfiguration(
+            regularization=optim.RegularizationContext(
+                optim.RegularizationType.L2
+            ),
+            regularization_weight=w,
+        )
+
+    return GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {
+            "global": FixedEffectCoordinateConfiguration(
+                "features", l2(1e-2)),
+            "per-user": RandomEffectCoordinateConfiguration(
+                RandomEffectDataConfiguration("userId", "features"),
+                l2(1.0),
+            ),
+        },
+        num_iterations=2,
+        evaluators=["AUC"],
+        mesh="off",
+    )
+
+
+def pilot_regressions(pilot: dict) -> list[str]:
+    """Pilot entries for the output's `regressions` list: the replay
+    must promote EVERY day, reload with zero compile events, and drop
+    zero requests across every promotion."""
+    out = []
+    if pilot.get("pilot_promotions", 0) < PILOT_DAYS:
+        out.append(
+            f"pilot promoted {pilot.get('pilot_promotions')} of "
+            f"{PILOT_DAYS} day(s) — the control loop stopped promoting")
+    if pilot.get("pilot_serving_compile_events") != 0:
+        out.append(
+            f"pilot promotions triggered "
+            f"{pilot.get('pilot_serving_compile_events')} serving "
+            "compile event(s) (zero-recompile promotion contract)")
+    if pilot.get("pilot_request_errors", 0) != 0:
+        out.append(
+            f"{pilot['pilot_request_errors']} request(s) dropped/"
+            "errored across the pilot's promotions")
+    if pilot.get("pilot_rollbacks", 0) or pilot.get("pilot_refusals", 0):
+        out.append(
+            "clean pilot replay recorded "
+            f"{pilot.get('pilot_rollbacks')} rollback(s) / "
+            f"{pilot.get('pilot_refusals')} refusal(s)")
+    if pilot.get("pilot_staleness_seconds") is None:
+        out.append(
+            "pilot scenario missing pilot_staleness_seconds "
+            "(staleness gauge dead)")
+    return out
+
+
 def roofline_regressions(name: str, cost_model: dict) -> list[str]:
     """The ``measured_vs_roofline`` gate (a tracked bench metric since
     round 8, not just a report field). A missing ratio is NOT a
@@ -1279,6 +1525,7 @@ def _apply_smoke():
     """
     global N_ROWS, N_USERS, N_MOVIES, MIN_MEASURE_SECONDS
     global N_SERVE_REQUESTS, STREAM_ROWS, STREAM_SHARDS, STREAM_USERS
+    global PILOT_USERS, PILOT_ROWS_PER_USER_DAY, PILOT_TRAFFIC_QPS
     N_ROWS = 20_000
     N_USERS = 500
     N_MOVIES = 100
@@ -1288,9 +1535,14 @@ def _apply_smoke():
     STREAM_ROWS = 6_000
     STREAM_SHARDS = 6
     STREAM_USERS = 120
+    # Pilot replay at CI scale (--pilot opt-in): same day count — the
+    # promotion COUNT is the gate — tiny per-day data + gentler load.
+    PILOT_USERS = 8
+    PILOT_ROWS_PER_USER_DAY = 6
+    PILOT_TRAFFIC_QPS = 120.0
 
 
-def run_smoke(streaming: bool = False) -> dict:
+def run_smoke(streaming: bool = False, pilot: bool = False) -> dict:
     """`bench.py --smoke`: the linear variant at CI scale, one JSON line.
 
     Asserts (in the output, for the CI job to check) that the pipeline
@@ -1341,6 +1593,10 @@ def run_smoke(streaming: bool = False) -> dict:
     if streaming:
         streaming_out = run_streaming()
         regressions.extend(streaming_regressions(streaming_out))
+    pilot_out = {}
+    if pilot:
+        pilot_out = run_pilot()
+        regressions.extend(pilot_regressions(pilot_out))
     regressions.extend(resilience_regressions())
     for key in ("serving_p50_ms", "serving_p99_ms", "serving_qps"):
         if serving.get(key) is None:
@@ -1380,6 +1636,7 @@ def run_smoke(streaming: bool = False) -> dict:
     out.update(_variant_fields("linear", lin))
     out.update(serving)
     out.update(streaming_out)
+    out.update(pilot_out)
     out["telemetry"] = telemetry
     return out
 
@@ -1401,6 +1658,13 @@ def main(argv=None):
         "scenario (write synthetic shards, stream-train day 1, "
         "warm-start retrain day 2) at CI scale; the full bench always "
         "includes it",
+    )
+    parser.add_argument(
+        "--pilot", action="store_true",
+        help="with --smoke: also run the pilot control-loop replay "
+        "(multi-day promote-under-traffic with staleness + "
+        "zero-recompile + zero-drop gates) at CI scale; the full "
+        "bench always includes it",
     )
     parser.add_argument(
         "--telemetry", default=None, metavar="PATH",
@@ -1430,7 +1694,7 @@ def main(argv=None):
 
     if args.smoke:
         _apply_smoke()
-        out = run_smoke(streaming=args.streaming)
+        out = run_smoke(streaming=args.streaming, pilot=args.pilot)
         from photon_tpu.utils import cache_stats
 
         out["compile_cache"] = cache_stats()
@@ -1445,6 +1709,7 @@ def main(argv=None):
     lin = run_variant("linear")
     serving = run_serving()
     streaming = run_streaming()
+    pilot = run_pilot()
     sklearn_anchor = run_sklearn_baseline(logi["train_seconds"])
     yahoo = run_yahoo_music()
     a9a = run_a1a_logistic()
@@ -1468,6 +1733,7 @@ def main(argv=None):
     regressions.extend(roofline_regressions("logistic", logi["cost_model"]))
     regressions.extend(serving_regressions(serving))
     regressions.extend(streaming_regressions(streaming))
+    regressions.extend(pilot_regressions(pilot))
     regressions.extend(resilience_regressions())
 
     out = {
@@ -1489,6 +1755,7 @@ def main(argv=None):
         out.update(_variant_fields(name, v))
     out.update(serving)
     out.update(streaming)
+    out.update(pilot)
     out.update(sklearn_anchor)
     out.update(yahoo)
     out.update(a9a)

@@ -85,6 +85,7 @@ DECLARING_MODULES = (
     "photon_tpu.obs",
     "photon_tpu.ops.newton_kernel",
     "photon_tpu.parallel.mesh",
+    "photon_tpu.pilot",
     "photon_tpu.resilience",
     "photon_tpu.serve",
 )
@@ -1653,6 +1654,111 @@ def build_evaluators() -> ContractTrace:
     )
 
 
+def build_pilot() -> ContractTrace:
+    """The pilot's zero-recompile promotion contract.
+
+    A promotion cycle's serving-side effect is exactly one call into
+    the reload path (``MicroBatchQueue.reload_model`` →
+    ``CoefficientTables.rebuild_from``, which short-circuits a
+    values-only delta to the in-place reference swap). Proof: a live
+    ladder's rungs are traced as the base programs; then TWO
+    consecutive day-over-day promotions — refreshed coefficient VALUES
+    on the same structure, the pinned-vocabulary steady state the pilot
+    maintains — drive that same swap, and every post-promotion trace
+    must be byte-identical to its rung's base program. The census bound
+    is the rung count: a control loop that minted even one program per
+    promotion would fail the round it shipped. The ``hot_loop`` walk
+    applies too: supervision must add no callback to the request path.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+    from photon_tpu.types import TaskType
+
+    d, e, s, du = 5, 6, 3, 5
+    rng = np.random.default_rng(20260804)
+
+    def day_model(scale: float) -> GameModel:
+        # Fixed projector/vocabulary across "days" — the pinned-vocab
+        # steady state every pilot promotion relies on.
+        prng = np.random.default_rng(99)
+        proj = np.sort(
+            np.stack([prng.permutation(du)[:s] for _ in range(e)]),
+            axis=1,
+        ).astype(np.int64)
+        return GameModel({
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(means=jnp.asarray(
+                        scale * rng.normal(size=d).astype(np.float32)
+                    )),
+                    TaskType.LOGISTIC_REGRESSION,
+                ),
+                "features",
+            ),
+            "per-user": RandomEffectModel(
+                coefficients=jnp.asarray(
+                    scale * rng.normal(size=(e, s)).astype(np.float32)
+                ),
+                random_effect_type="userId",
+                feature_shard_id="userShard",
+                task=TaskType.LOGISTIC_REGRESSION,
+                proj_all=proj,
+                entity_keys=tuple(str(i) for i in range(e)),
+            ),
+        })
+
+    ladder = ShapeLadder((1, 8))
+    tables = CoefficientTables.from_game_model(day_model(1.0))
+    programs = ScorePrograms(tables, ladder=ladder, compile_now=False)
+
+    def trace_rungs() -> dict[str, TracedProgram]:
+        out = {}
+        for r in ladder.rungs:
+            traced = programs.trace(r)
+            out[f"score_b{r}"] = TracedProgram(
+                name=f"score_b{r}",
+                text=str(traced.jaxpr),
+                jaxpr=traced.jaxpr,
+                lowered=traced.lower(),
+            )
+        return out
+
+    base = trace_rungs()
+    variants: dict[str, list[dict[str, str]]] = {"promotion_cycle": []}
+    for scale in (1.7, 0.6):  # two consecutive "days"
+        # The pilot's PROMOTE serving swap: rebuild_from short-circuits
+        # the values-only delta to the in-place reference swap (the
+        # exact call chain under MicroBatchQueue.reload_model). Were
+        # the refresh NOT values-only, the re-trace below would mint
+        # new signatures and fail the stability check — which is the
+        # finding this contract exists to catch.
+        tables.rebuild_from(day_model(scale), programs=None)
+        variants["promotion_cycle"].append({
+            name: prog.signature
+            for name, prog in trace_rungs().items()
+        })
+    return ContractTrace(
+        programs=base,
+        variants=variants,
+        notes=[
+            f"2 consecutive values-only promotions over ladder "
+            f"{ladder.rungs}: every post-promotion trace is "
+            "byte-identical to its rung's base program — the control "
+            "loop adds zero serving programs",
+        ],
+    )
+
+
 _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_fused_fit": build_fused_fit,
     "build_fused_cache_keys": build_fused_cache_keys,
@@ -1663,6 +1769,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_telemetry": build_telemetry,
     "build_trace": build_trace,
     "build_monitor": build_monitor,
+    "build_pilot": build_pilot,
     "build_serving": build_serving,
     "build_resilience": build_resilience,
     "build_streaming_ingest": build_streaming_ingest,

@@ -59,6 +59,14 @@ TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
     # gate the round it happens, same policy as the serving block.
     "streaming_incremental_rows_per_sec": ("higher", 1.5, ()),
     "streaming_ingest_rows_per_sec": ("higher", 1.5, ()),
+    # Pilot control loop (round 11+, photon_tpu.pilot): staleness is
+    # shard-landed -> model-serving seconds for the multi-day replay,
+    # and the promotion count is the "did the loop keep promoting"
+    # dead-man switch — a pilot that silently stops promoting, or whose
+    # data-to-serving latency regresses >1.5x, fails the trend gate the
+    # round it happens.
+    "pilot_staleness_seconds": ("lower", 1.5, ()),
+    "pilot_promotions": ("higher", 1.5, ()),
 }
 
 

@@ -63,6 +63,17 @@ def main(argv=None) -> int:
                         help="consecutive dispatch failures that trip "
                              "the circuit breaker (drain + fail fast); "
                              "0 disables")
+    parser.add_argument("--reload-model", action="append", default=[],
+                        metavar="PATH",
+                        help="after the main drive, hot-reload this "
+                             "model (npz checkpoint or Avro model dir) "
+                             "into the LIVE queue and drive the "
+                             "requests again — values-only refreshes "
+                             "swap in place with zero recompiles, "
+                             "structure changes rebuild tables + "
+                             "ladder off-path and swap under the "
+                             "queue's quiesce (repeatable; per-reload "
+                             "summaries ride the output JSON)")
     parser.add_argument("--target-qps", type=float, default=None,
                         help="pace submissions at this offered load "
                              "(default: flood — closed-loop saturation)")
@@ -332,6 +343,25 @@ def _serve_instrumented(
                 # quantiles, hotness, SLO burn).
                 mon.add_collector(queue.metrics_families)
             summary = drive(queue, requests, rate=args.target_qps)
+            reloads = []
+            for path in args.reload_model:
+                # Hot model swap on the LIVE queue (serve/tables.py
+                # rebuild_from via queue.reload_model): values-only
+                # refreshes flip references under dispatch; structure
+                # changes rebuild tables + ladder off-path and swap
+                # under quiesce — then the SAME requests drive again
+                # so the output proves the swapped generation serves.
+                refreshed = _load_reload_model(args, path)
+                r_before = compile_event_count()
+                info = queue.reload_model(refreshed)
+                info["compile_events"] = (
+                    compile_event_count() - r_before
+                )
+                info["model"] = path
+                info["summary"] = drive(
+                    queue, requests, rate=args.target_qps
+                )
+                reloads.append(info)
             health = queue.health()
     after = compile_event_count()
 
@@ -355,6 +385,8 @@ def _serve_instrumented(
     }
     if mon is not None:
         out["monitor"] = {"port": mon.port, **mon.scrape_stats()}
+    if reloads:
+        out["reloads"] = reloads
     out.update(summary)
     if args.telemetry:
         obs.write_jsonl(args.telemetry)
@@ -368,8 +400,28 @@ def _serve_instrumented(
     print(json.dumps(out))
     # Partial failures must be visible to exit-code-only consumers
     # (health checks): errored requests already excluded the latency
-    # stats, and a clean exit would mislabel the run healthy.
-    return 0 if summary["errors"] == 0 else 1
+    # stats, and a clean exit would mislabel the run healthy — in any
+    # generation, including post-reload drives.
+    errors = summary["errors"] + sum(
+        r["summary"]["errors"] for r in reloads
+    )
+    return 0 if errors == 0 else 1
+
+
+def _load_reload_model(args, path: str):
+    """A ``--reload-model`` artifact: native checkpoint (self-
+    contained) or Avro model directory (keyed against its own records,
+    the standalone-serving convention — a values-only swap therefore
+    needs the refreshed model saved against the same feature space)."""
+    if os.path.isfile(path) or path.endswith(".npz"):
+        from photon_tpu.io.model_io import load_checkpoint
+
+        return load_checkpoint(path)
+    from photon_tpu.io.model_io import load_game_model
+    from photon_tpu.serve.tables import build_index_maps_from_model
+
+    model, _ = load_game_model(path, build_index_maps_from_model(path))
+    return model
 
 
 if __name__ == "__main__":

@@ -152,7 +152,9 @@ def _count_records(path: str) -> int | None:
         return None
 
 
-def build_shard_manifest(stream_dir: str) -> dict:
+def build_shard_manifest(
+    stream_dir: str, shard_names: list[str] | None = None
+) -> dict:
     """Scan ``stream_dir``'s Avro shards into the integrity manifest.
 
     Per shard: file name (relative), byte size, sha256, record count
@@ -160,10 +162,18 @@ def build_shard_manifest(stream_dir: str) -> dict:
     (the stable global row position ``_uid_to_int`` falls back to for
     uid-less records, independent of quarantine decisions so resume
     and quarantine never shift downstream sampling keys).
+
+    ``shard_names`` (base names) restricts the manifest to an explicit
+    snapshot — how the pilot freezes a cycle's input set so shards
+    landing MID-CYCLE wait for the next cycle instead of changing the
+    manifest under a committed cursor.
     """
+    wanted = None if shard_names is None else set(shard_names)
     shards = []
     offset = 0
     for path in data_shard_files(stream_dir):
+        if wanted is not None and os.path.basename(path) not in wanted:
+            continue
         digest, size = _hash_file(path)
         records = _count_records(path)
         shards.append({
@@ -335,11 +345,19 @@ class StreamingIngest:
         window_shards: int = 1,
         quarantine: QuarantinePolicy | None = None,
         resume: bool = False,
+        shard_names: list[str] | None = None,
     ):
         if window_shards < 1:
             raise ValueError("window_shards must be >= 1")
         self.stream_dir = stream_dir
         self.work_dir = work_dir
+        # Explicit shard snapshot (base names): the manifest — and
+        # therefore the cursor and every downstream row offset — covers
+        # exactly these files, whatever lands in stream_dir later. A
+        # resumed run keeps the COMMITTED manifest's snapshot.
+        self.shard_names = (
+            None if shard_names is None else [str(s) for s in shard_names]
+        )
         self.feature_shards = dict(
             feature_shards or {"features": ["features"]}
         )
@@ -443,8 +461,20 @@ class StreamingIngest:
             with open(producer, "rb") as f:
                 raw = f.read()
             manifest = json.loads(raw.decode())
+            if self.shard_names is not None:
+                wanted = set(self.shard_names)
+                manifest = dict(
+                    manifest,
+                    shards=[
+                        s for s in manifest["shards"]
+                        if s["name"] in wanted
+                    ],
+                )
+                raw = _manifest_bytes(manifest)
         else:
-            manifest = build_shard_manifest(self.stream_dir)
+            manifest = build_shard_manifest(
+                self.stream_dir, self.shard_names
+            )
             raw = _manifest_bytes(manifest)
         from photon_tpu.io.model_io import atomic_write_bytes
 

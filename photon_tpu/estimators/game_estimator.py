@@ -837,6 +837,61 @@ class GameEstimator:
             total = vs if total is None else total + vs
         return val_ctx.suite.evaluate(total)
 
+    def evaluate_model(
+        self,
+        model: GameModel,
+        data: GameDataset,
+        validation: GameDataset,
+        *,
+        initial_model: GameModel | None = None,
+    ) -> EvaluationResults:
+        """Evaluate an ARBITRARY GameModel (e.g. the currently-serving
+        generation) against ``validation`` with this estimator's
+        evaluator suite — the same scorers and metric path a
+        ``fit(validation=...)`` run records, so the pilot's promotion
+        gate compares candidate and incumbent through one ruler.
+
+        ``data`` provides the per-coordinate layouts the scorers remap
+        onto (the same dataset the candidate trained on); pass the same
+        ``initial_model`` the fit used so ``prepare``'s cache is reused
+        instead of rebuilt. Random-effect sub-models whose entity
+        vocabulary or projector layout differ from the dataset's are
+        remapped by (entity key, feature id) first — entities the
+        layout lacks score through the fixed effect, photon-ml's
+        left-join semantics.
+        """
+        import numpy as np
+
+        datasets, val_ctx = self.prepare(
+            data, validation=validation, initial_model=initial_model
+        )
+        if val_ctx is None:  # pragma: no cover — prepare always builds
+            # a context when validation is given; belt for refactors.
+            raise ValueError("evaluate_model needs a validation dataset")
+        for cid in self.update_sequence:
+            if cid not in model:
+                continue
+            m = model[cid]
+            if not isinstance(m, RandomEffectModel):
+                continue
+            ds = datasets[cid]
+            if (
+                tuple(str(k) for k in m.entity_keys)
+                != tuple(str(k) for k in ds.entity_keys)
+                or not np.array_equal(
+                    np.asarray(m.proj_all), np.asarray(ds.proj_all)
+                )
+            ):
+                model = model.updated(
+                    cid,
+                    remap_random_effect_model(
+                        m,
+                        entity_keys=ds.entity_keys,
+                        proj_all=ds.proj_all,
+                    ),
+                )
+        return self._score_with_validation(val_ctx, model)
+
     def _full_config(self, opt_configs):
         return {
             cid: opt_configs.get(

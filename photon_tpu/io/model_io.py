@@ -585,6 +585,7 @@ def save_checkpoint(
     path: str,
     *,
     extra_meta: dict | None = None,
+    fault_point: str | None = "checkpoint.write",
 ) -> str:
     """One-file native GameModel checkpoint (.npz + JSON manifest).
 
@@ -595,7 +596,11 @@ def save_checkpoint(
     npz under a reserved key — the training checkpointer stores its
     loop state (config/iteration cursor, static key) there so the
     artifact is self-contained; read it back with
-    ``load_checkpoint_meta``.
+    ``load_checkpoint_meta``. ``fault_point`` names the injection point
+    fired in the mid-write crash window (default the training
+    checkpointer's ``checkpoint.write``; the pilot's generation ring
+    passes its own ``pilot.promote`` so chaos CI can kill exactly
+    between the ring commit and the serving reload).
 
     Returns the sha256 hex digest of the committed bytes, hashed from
     the in-memory serialization — callers recording content hashes
@@ -647,7 +652,7 @@ def save_checkpoint(
     np.savez_compressed(buf, **arrays)
     data = buf.getbuffer()  # zero-copy view; getvalue() would double peak RSS
     digest = hashlib.sha256(data).hexdigest()
-    atomic_write_bytes(path, data, fault_point="checkpoint.write")
+    atomic_write_bytes(path, data, fault_point=fault_point)
     return digest
 
 

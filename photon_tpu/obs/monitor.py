@@ -118,6 +118,22 @@ def family(name: str, mtype: str, help_: str, samples) -> dict:
     }
 
 
+def state_family(name: str, states, current, help_: str) -> dict:
+    """A Prometheus state-set: one-hot gauge samples labeled by state
+    (``name{state="INGEST"} 1`` next to zeros for the others) — the
+    queryable form of an enum-valued gauge like the pilot's
+    state-machine stage. ``current`` must be one of ``states``."""
+    states = tuple(states)
+    if current not in states:
+        raise ValueError(
+            f"state {current!r} is not one of the declared {states}")
+    return family(
+        name, "gauge", help_,
+        [("", {"state": s}, 1.0 if s == current else 0.0)
+         for s in states],
+    )
+
+
 def metric_name(raw: str) -> str:
     """Sanitize to the exposition charset ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
     out = [

@@ -1,0 +1,47 @@
+"""photon_tpu.pilot — an always-on train→validate→promote→rollback
+control loop that survives every failure it supervises.
+
+The photon-client driver surface (PAPER.md layer map) rebuilt as a
+supervisor daemon: watch a shard directory, stream-ingest new data,
+warm-start retrain, gate promotion on the evaluation suite versus the
+serving model, hot-reload the live scorer with zero recompiles, observe
+post-promotion SLO burn, and auto-roll back from a bounded on-disk ring
+of previous generations. State machine, stage semantics, gate and
+rollback policy, metrics: PILOT.md.
+
+Run it: ``python -m photon_tpu.cli.pilot --config pilot.yaml``.
+"""
+
+from __future__ import annotations
+
+from photon_tpu.pilot.loop import (
+    PROGRAM_AUDIT,
+    ObservePolicy,
+    Pilot,
+    PilotConfig,
+    PromotionGate,
+)
+from photon_tpu.pilot.ring import GenerationRing
+from photon_tpu.pilot.serving import PilotServer
+from photon_tpu.pilot.state import (
+    MODE_ACTIVE,
+    MODE_SERVE_ONLY,
+    STAGES,
+    PilotState,
+    load_state,
+)
+
+__all__ = [
+    "GenerationRing",
+    "MODE_ACTIVE",
+    "MODE_SERVE_ONLY",
+    "ObservePolicy",
+    "PROGRAM_AUDIT",
+    "Pilot",
+    "PilotConfig",
+    "PilotServer",
+    "PilotState",
+    "PromotionGate",
+    "STAGES",
+    "load_state",
+]

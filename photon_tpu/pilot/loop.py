@@ -1,0 +1,958 @@
+"""photon_tpu.pilot — the always-on train→validate→promote→rollback loop.
+
+Photon-ML's photon-client layer is a human-driven batch driver; this is
+that surface rebuilt as a production control loop (ROADMAP item 4). The
+``Pilot`` watches a shard directory and, per cycle: freezes the shard
+snapshot, streams it in through ``data/stream.py`` (bounded memory,
+integrity manifest, resumable cursor), warm-start retrains from the
+live generation under the PR-7 training checkpointer, gates promotion
+on the evaluation suite versus the CURRENTLY-SERVING model, hot-reloads
+the live scorer through ``MicroBatchQueue.reload_model`` (values-only:
+zero recompiles; structure change: off-path ladder rebuild under
+quiesce), then OBSERVES post-promotion SLO burn and auto-rolls back to
+the previous ring generation when it crosses the declared threshold.
+
+Robustness is the headline, not the garnish:
+
+- **Atomic state machine** — every IDLE→INGEST→TRAIN→VALIDATE→PROMOTE→
+  OBSERVE transition commits ``pilot-state.json`` through
+  ``atomic_write_bytes``; a killed pilot resumes exactly at the
+  committed stage (``pilot/state.py``).
+- **Stage retry + deadlines** — each stage runs under
+  ``resilience.retry`` behind its own seeded fault point
+  (``pilot.ingest`` / ``pilot.train`` / ``pilot.validate`` /
+  ``pilot.promote`` / ``pilot.rollback``); a stage exceeding its
+  declared deadline is recorded as an overrun and counts toward
+  degradation.
+- **Degrade, never die** — consecutive failed (or overrun) cycles back
+  off exponentially and, past ``max_consecutive_failures``, drop the
+  pilot to SERVE-ONLY mode: the live scorer keeps serving the last
+  good generation while the trainer is wedged; ``reset_serve_only()``
+  re-arms after the operator intervenes.
+- **Bounded rollback inventory** — ``pilot/ring.py`` keeps the newest N
+  generations on disk; promotion is a two-step staged→live commit so a
+  kill between the generation write and the ``reload()`` leaves the
+  server on the old generation and the promotion resumable.
+- **Every bad outcome leaves evidence** — refusals record their
+  per-metric reasons in the state file, and refusals AND rollbacks dump
+  a flight-recorder post-mortem (``obs/flight.py``).
+
+Vocabulary pinning: by default the first cycle's scanned vocabulary is
+committed (``pilot-vocab.json``) and reused by every later cycle, so
+day-over-day retrains keep table shapes — and therefore the compiled
+score ladder — fixed (the zero-recompile promotion the tier-2 ``pilot``
+contract audits). Unpinned runs still work: a grown vocabulary is a
+structure change and promotes through the quiesced rebuild instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+
+from photon_tpu.pilot.ring import GenerationRing
+from photon_tpu.pilot.state import (
+    MODE_ACTIVE,
+    MODE_SERVE_ONLY,
+    STAGES,
+    PilotState,
+    commit_state,
+    load_state,
+)
+
+logger = logging.getLogger(__name__)
+
+# Program contract (audited by `python -m photon_tpu.analysis
+# --semantic`; builder build_pilot in analysis/program.py): one full
+# promotion cycle against a live score ladder — values-only reload via
+# the same ``reload_model`` path the pilot's PROMOTE stage drives — must
+# add ZERO serving programs: the census stays at the ladder's rung count
+# and every post-promotion trace is byte-identical to its rung's base
+# program (stable_under=promotion_cycle). The control loop is host
+# machinery; promoting a model may never perturb what XLA compiles.
+PROGRAM_AUDIT = dict(
+    name="pilot",
+    entry="pilot.loop promotion cycle -> serve score ladder "
+    "(reload_model values-only swap)",
+    builder="build_pilot",
+    max_programs=2,
+    stable_under=("promotion_cycle",),
+    hot_loop=True,
+)
+
+_VOCAB_FILE = "pilot-vocab.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class PromotionGate:
+    """Candidate-vs-serving promotion policy.
+
+    ``min_delta`` maps metric name -> required improvement IN THE
+    METRIC'S BETTER DIRECTION (so +0.01 on RMSE means "at least 0.01
+    LOWER"); negative values grant a regression allowance. Metrics not
+    named require ``>= 0`` improvement only if ``require_primary`` and
+    they are the primary metric; others are recorded but not gating.
+    The very first generation (no incumbent) auto-passes.
+    """
+
+    min_delta: dict = dataclasses.field(default_factory=dict)
+    require_primary: bool = True
+
+    def decide(self, specs, candidate: dict, incumbent: dict) -> list[str]:
+        """Refusal reasons (empty = promote)."""
+        reasons = []
+        by_name = {s.name: s for s in specs}
+        gated = dict(self.min_delta)
+        if self.require_primary and specs:
+            gated.setdefault(specs[0].name, 0.0)
+        for metric, need in gated.items():
+            spec = by_name.get(metric)
+            if spec is None or metric not in candidate \
+                    or metric not in incumbent:
+                reasons.append(
+                    f"{metric}: gated metric not evaluated "
+                    f"(have {sorted(candidate)})")
+                continue
+            sign = 1.0 if spec.bigger_is_better else -1.0
+            improvement = sign * (candidate[metric] - incumbent[metric])
+            if improvement < need:
+                reasons.append(
+                    f"{metric}: improvement {improvement:+.6g} < "
+                    f"required {need:+.6g} (candidate "
+                    f"{candidate[metric]:.6g} vs serving "
+                    f"{incumbent[metric]:.6g})")
+        return reasons
+
+
+@dataclasses.dataclass(frozen=True)
+class ObservePolicy:
+    """Post-promotion observation window + rollback triggers."""
+
+    window_s: float = 2.0
+    poll_s: float = 0.25
+    # Any of these crossing rolls the promotion back:
+    max_dispatch_errors: int = 0  # dispatch-error DELTA over the window
+    max_error_burn: float = 0.0  # SLO error-budget short-window burn
+    rollback_on_breaker: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class PilotConfig:
+    """Everything the control loop needs, declared once."""
+
+    stream_dir: str
+    work_dir: str
+    estimator_factory: object  # () -> GameEstimator
+    # Optional HELD-OUT validation shard directory: when set, the
+    # promotion gate scores candidate and incumbent on THIS data
+    # (streamed each cycle under the pinned vocabulary) instead of the
+    # candidate's own training data. Without it the gate compares
+    # in-sample — operationally useful (a broken retrain still refuses)
+    # but biased toward promotion for overfit candidates; production
+    # pilots should point this at a holdout stream.
+    validation_dir: str | None = None
+    window_shards: int = 1
+    keep_generations: int = 3
+    # Per-cycle work dirs (ingest spills, training checkpoints, the
+    # candidate npz) kept on disk after a cycle completes — the bounded
+    # companion to the generation ring's retention.
+    keep_cycle_dirs: int = 2
+    gate: PromotionGate = dataclasses.field(default_factory=PromotionGate)
+    observe: ObservePolicy = dataclasses.field(
+        default_factory=ObservePolicy)
+    # Per-stage soft deadlines, seconds (stage name lower-cased ->
+    # budget; a finished stage past its budget is an OVERRUN: recorded,
+    # counted toward degradation, but its work is kept — discarding a
+    # completed retrain because it was slow would burn the cycle twice).
+    stage_deadline_s: dict = dataclasses.field(default_factory=dict)
+    max_consecutive_failures: int = 3
+    backoff_base_s: float = 1.0
+    backoff_cap_s: float = 60.0
+    retry: object = None  # resilience.RetryPolicy | None (default policy)
+    pin_vocabulary: bool = True
+    ingest_kwargs: dict = dataclasses.field(default_factory=dict)
+
+
+class Pilot:
+    """The supervisor. Single-threaded by design: the one control
+    thread runs stages in order and commits each transition; all
+    serving concurrency stays inside the queue it supervises."""
+
+    def __init__(self, config: PilotConfig, *, server=None,
+                 server_factory=None):
+        self.config = config
+        self.server = server
+        self.server_factory = server_factory
+        os.makedirs(config.work_dir, exist_ok=True)
+        self.ring = GenerationRing(
+            os.path.join(config.work_dir, "generations"),
+            keep=config.keep_generations,
+        )
+        self.state = load_state(config.work_dir) or PilotState()
+        self._commit()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _commit(self) -> None:
+        commit_state(self.config.work_dir, self.state)
+        self._export_gauges()
+
+    def _cycle_dir(self, cycle: int | None = None) -> str:
+        c = self.state.cycle if cycle is None else cycle
+        return os.path.join(self.config.work_dir, f"cycle-{c:05d}")
+
+    def _retry_policy(self):
+        from photon_tpu.resilience.retry import DEFAULT_POLICY
+
+        return self.config.retry or DEFAULT_POLICY
+
+    def _stage_run(self, stage: str, point: str, fn):
+        """One stage body: fault point + transient retry inside,
+        deadline bookkeeping outside. Returns ``fn()``'s result."""
+        from photon_tpu.resilience import retry
+
+        t0 = time.monotonic()
+        out = retry.retrying_check(
+            point, fn, site=point, policy=self._retry_policy()
+        )
+        took = time.monotonic() - t0
+        budget = self.config.stage_deadline_s.get(stage.lower())
+        if budget is not None and took > budget:
+            self.state.deadline_overruns += 1
+            self.state.consecutive_failures += 1
+            self._maybe_degrade(
+                f"stage {stage} overran its {budget:g}s deadline "
+                f"({took:.3f}s)")
+            self._commit()
+            logger.warning(
+                "pilot: stage %s finished but overran its deadline "
+                "(%.3fs > %gs) — counted toward degradation",
+                stage, took, budget)
+        return out
+
+    def _maybe_degrade(self, why: str) -> None:
+        if (
+            self.state.mode == MODE_ACTIVE
+            and self.state.consecutive_failures
+            >= self.config.max_consecutive_failures
+        ):
+            self.state.mode = MODE_SERVE_ONLY
+            self.state.last_error = why
+            logger.error(
+                "pilot: %d consecutive failure(s) — degrading to "
+                "SERVE-ONLY mode (the live scorer keeps serving; "
+                "reset_serve_only() re-arms the trainer): %s",
+                self.state.consecutive_failures, why)
+
+    def reset_serve_only(self) -> None:
+        """Operator action: re-arm a pilot that degraded to serve-only."""
+        self.state.mode = MODE_ACTIVE
+        self.state.consecutive_failures = 0
+        self._commit()
+
+    def backoff_s(self) -> float:
+        """Suggested sleep before the next cycle attempt (exponential in
+        the consecutive-failure count, capped)."""
+        n = self.state.consecutive_failures
+        if n <= 0:
+            return 0.0
+        return min(
+            self.config.backoff_base_s * (2.0 ** (n - 1)),
+            self.config.backoff_cap_s,
+        )
+
+    # -- shard watching ----------------------------------------------------
+
+    def _all_shards(self) -> list[str]:
+        from photon_tpu.io.avro_data import data_shard_files
+
+        return [
+            os.path.basename(p)
+            for p in data_shard_files(self.config.stream_dir)
+        ]
+
+    def pending_shards(self) -> tuple[list[str], list[str]]:
+        """(all shards, shards not yet trained into a generation)."""
+        all_shards = self._all_shards()
+        seen = set(self.state.processed_shards)
+        return all_shards, [s for s in all_shards if s not in seen]
+
+    def _landed_at(self, names: list[str]) -> float:
+        stamps = []
+        for name in names:
+            try:
+                stamps.append(os.path.getmtime(
+                    os.path.join(self.config.stream_dir, name)))
+            except OSError:
+                pass
+        return max(stamps) if stamps else time.time()
+
+    # -- vocabulary pin ----------------------------------------------------
+
+    def _vocab_path(self) -> str:
+        return os.path.join(self.config.work_dir, _VOCAB_FILE)
+
+    def _pinned_vocab(self) -> dict | None:
+        path = self._vocab_path()
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def _save_vocab(self, ingest) -> None:
+        from photon_tpu.io.model_io import atomic_write_bytes
+
+        payload = {
+            "maps": {
+                s: dict(m.items())
+                for s, m in ingest.resolved_maps.items()
+            },
+            "id_tag_names": list(
+                ingest.id_tag_names if ingest.id_tag_names != "auto"
+                else ()
+            ),
+            "response_field": ingest.response_field,
+        }
+        atomic_write_bytes(
+            self._vocab_path(),
+            json.dumps(payload, indent=2, sort_keys=True).encode(),
+        )
+
+    # -- stages ------------------------------------------------------------
+
+    def _vocab_kwargs(self) -> dict:
+        """Ingest kwargs carrying the pinned vocabulary (or the current
+        cycle's resolved one for unpinned runs — set by ``_ingest``)."""
+        from photon_tpu.data.index_map import IndexMap
+
+        kwargs = dict(self.config.ingest_kwargs)
+        vocab = self._pinned_vocab() if self.config.pin_vocabulary else None
+        if vocab is None:
+            vocab = getattr(self, "_cycle_vocab", None)
+        if vocab is not None:
+            kwargs.setdefault("index_maps", {
+                s: IndexMap({k: int(v) for k, v in fwd.items()})
+                for s, fwd in vocab["maps"].items()
+            })
+            kwargs.setdefault("id_tag_names", vocab["id_tag_names"])
+            kwargs.setdefault("response_field", vocab["response_field"])
+        return kwargs
+
+    def _run_ingest(self, stream_dir: str, work_name: str,
+                    shard_names: list | None):
+        from photon_tpu.data.stream import MANIFEST_FILE, StreamingIngest
+        from photon_tpu.resilience.errors import ResumeMismatchError
+
+        ingest_dir = os.path.join(self._cycle_dir(), work_name)
+        kwargs = self._vocab_kwargs()
+
+        def build(resume: bool):
+            return StreamingIngest(
+                stream_dir,
+                work_dir=ingest_dir,
+                shard_names=shard_names,
+                window_shards=self.config.window_shards,
+                resume=resume,
+                **kwargs,
+            )
+
+        resume = os.path.exists(os.path.join(ingest_dir, MANIFEST_FILE))
+        try:
+            ingest = build(resume)
+            data, stats = ingest.run()
+        except ResumeMismatchError as exc:
+            if not resume:
+                raise
+            # The interrupted attempt ran under a different ingest
+            # identity (the common case: the FIRST cycle's vocabulary
+            # scan committed the pin between its ingest and its crash,
+            # so the resume now carries pinned maps the cursor never
+            # saw). A fresh ingest under the current identity is always
+            # correct — resume is an optimization, never a requirement.
+            logger.warning(
+                "pilot: ingest resume refused (%s); re-ingesting "
+                "cycle %d %s fresh", exc, self.state.cycle, work_name)
+            import shutil
+
+            shutil.rmtree(ingest_dir, ignore_errors=True)
+            ingest = build(False)
+            data, stats = ingest.run()
+        return data, stats, ingest
+
+    def _ingest(self):
+        had_pin = (
+            self.config.pin_vocabulary
+            and self._pinned_vocab() is not None
+        )
+        data, stats, ingest = self._run_ingest(
+            self.config.stream_dir, "ingest",
+            list(self.state.cycle_shards),
+        )
+        if self.config.pin_vocabulary and not had_pin:
+            self._save_vocab(ingest)
+        # The resolved vocabulary (pinned or this cycle's scan) also
+        # keys the validation ingest, so a held-out set always indexes
+        # features exactly as training did.
+        self._cycle_vocab = {
+            "maps": {
+                s: dict(m.items())
+                for s, m in ingest.resolved_maps.items()
+            },
+            "id_tag_names": list(
+                ingest.id_tag_names
+                if ingest.id_tag_names != "auto" else ()
+            ),
+            "response_field": ingest.response_field,
+        }
+        return data, stats
+
+    def _validation_data(self):
+        """The held-out validation dataset for this cycle, or None when
+        ``validation_dir`` is unset (the gate then compares in-sample —
+        see PilotConfig.validation_dir)."""
+        if self.config.validation_dir is None:
+            return None
+        data, _, _ = self._run_ingest(
+            self.config.validation_dir, "validate-ingest", None
+        )
+        return data
+
+    def _candidate_path(self) -> str:
+        return os.path.join(self._cycle_dir(), "candidate.npz")
+
+    def _train(self, data):
+        """Warm-start retrain under the training checkpointer; commits
+        the candidate npz so VALIDATE/PROMOTE resumes never retrain."""
+        from photon_tpu.io.model_io import load_checkpoint, save_checkpoint
+        from photon_tpu.resilience.checkpoint import (
+            TrainingCheckpointer,
+            load_training_checkpoint,
+            training_static_key,
+        )
+
+        cand_path = self._candidate_path()
+        if os.path.exists(cand_path):
+            # A prior attempt finished TRAIN and committed the
+            # candidate before dying mid-transition: keep its work.
+            return load_checkpoint(cand_path), self._init_model()
+        est = self.config.estimator_factory()
+        init = self._init_model()
+        ckpt_dir = os.path.join(self._cycle_dir(), "train")
+        key = training_static_key(est, None)
+        resume = None
+        if os.path.exists(os.path.join(ckpt_dir, "manifest.json")):
+            resume = load_training_checkpoint(ckpt_dir)
+        checkpointer = TrainingCheckpointer(ckpt_dir, key)
+        try:
+            results = est.fit(
+                data,
+                initial_model=init,
+                checkpointer=checkpointer,
+                resume=resume,
+            )
+            model = results[0].model
+        except ValueError as exc:
+            # The crash window between the final iteration's checkpoint
+            # + config-final retention and the candidate commit: the
+            # chain says "already completed" — finalize from it.
+            if resume is None or "already completed" not in str(exc):
+                raise
+            from photon_tpu.resilience.checkpoint import load_config_final
+
+            model = load_config_final(ckpt_dir, 0, key)
+        save_checkpoint(model, cand_path, fault_point=None)
+        return model, init
+
+    def _init_model(self):
+        return (
+            self.ring.load(self.ring.live)
+            if self.ring.live is not None else None
+        )
+
+    def _validate(self, data, candidate, init):
+        """Candidate vs serving through ONE evaluation ruler — on the
+        held-out set when ``validation_dir`` is configured, else
+        in-sample; returns (candidate_metrics, incumbent_metrics|None,
+        refusal_reasons)."""
+        from photon_tpu.evaluation.evaluators import EvaluatorSpec
+
+        val = self._validation_data()
+        if val is None:
+            val = data
+        est = self.config.estimator_factory()
+        cand = est.evaluate_model(
+            candidate, data, val, initial_model=init
+        )
+        if init is None:
+            return dict(cand.evaluations), None, []
+        inc = est.evaluate_model(init, data, val, initial_model=init)
+        specs = [
+            s if isinstance(s, EvaluatorSpec) else EvaluatorSpec.parse(s)
+            for s in (est.evaluators or ())
+        ] or [cand.primary_evaluator]
+        reasons = self.config.gate.decide(
+            specs, dict(cand.evaluations), dict(inc.evaluations)
+        )
+        return dict(cand.evaluations), dict(inc.evaluations), reasons
+
+    def _promote(self, candidate, metrics) -> dict:
+        """Two-step staged→live promotion. The ``pilot.promote`` fault
+        point fires twice per clean cycle: once inside the generation
+        npz's atomic-write window (ring commit can be killed mid-write)
+        and once between the ring commit and the serving reload — the
+        window the kill-during-promotion test aims SIGTERM at."""
+        from photon_tpu.resilience import faults, retry
+
+        gen = self.ring.staged
+        if gen is None:
+            gen = self.ring.stage_candidate(
+                candidate, cycle=self.state.cycle, metrics=metrics
+            )
+        faults.check("pilot.promote")
+        reload_out = {"values_only": None, "programs_compiled": 0}
+        if self.server is None and self.server_factory is not None:
+            self.server = self.server_factory(candidate)
+            reload_out = {
+                "values_only": None,
+                "programs_compiled":
+                    self.server.programs.stats["programs_compiled"],
+            }
+        elif self.server is not None:
+            reload_out = retry.call_with_retry(
+                lambda: self.server.reload(candidate),
+                site="pilot.promote.reload",
+                policy=self._retry_policy(),
+            )
+        self.ring.commit_live(gen)
+        return {
+            "generation": gen,
+            "values_only": reload_out.get("values_only"),
+            "programs_compiled": reload_out.get("programs_compiled", 0),
+            "compile_events": reload_out.get("compile_events"),
+            "table_generation": reload_out.get("generation"),
+        }
+
+    def _observe_baseline(self) -> dict:
+        if self.server is None:
+            return {}
+        h = self.server.health()
+        return {
+            "dispatch_errors": h.get("dispatch_errors", 0),
+            "requests": h.get("requests", 0),
+        }
+
+    def _burn_verdict(self, baseline: dict) -> str | None:
+        """A non-None string names the rollback trigger."""
+        if self.server is None:
+            return None
+        policy = self.config.observe
+        h = self.server.health()
+        # A pilot restart resets the queue's counters; rebase so stale
+        # baselines from before the crash never mask (or invent) burn.
+        base_err = min(
+            baseline.get("dispatch_errors", 0),
+            h.get("dispatch_errors", 0),
+        )
+        err_delta = h.get("dispatch_errors", 0) - base_err
+        if policy.rollback_on_breaker and h.get("breaker_open"):
+            return (
+                "dispatch circuit breaker OPEN post-promotion "
+                f"(after {h.get('consecutive_failures')} consecutive "
+                "failures)")
+        if err_delta > policy.max_dispatch_errors:
+            return (
+                f"{err_delta} dispatch error(s) inside the observation "
+                f"window (budget {policy.max_dispatch_errors})")
+        slo = h.get("slo") or {}
+        err = slo.get("error_rate") or {}
+        burn = err.get("burn_short") or 0.0
+        if burn > policy.max_error_burn:
+            return (
+                f"error-rate SLO short-window burn {burn:g} > budget "
+                f"{policy.max_error_burn:g}")
+        return None
+
+    def _observe(self, started_at: float, baseline: dict) -> str | None:
+        """Watch the window out; returns the rollback trigger or None."""
+        policy = self.config.observe
+        while True:
+            verdict = self._burn_verdict(baseline)
+            if verdict is not None:
+                return verdict
+            remaining = policy.window_s - (time.time() - started_at)
+            if remaining <= 0 or self.server is None:
+                return None
+            time.sleep(min(policy.poll_s, max(remaining, 0.01)))
+
+    def _rollback(self, reason: str) -> dict:
+        """Auto-rollback to the previous ring generation; the flight
+        recorder gets a post-mortem either way."""
+        from photon_tpu.obs import flight
+        from photon_tpu.resilience import faults, retry
+
+        bad = self.ring.live
+        target = self.ring.previous(bad)
+        if target is None:
+            # Nothing older to serve: keep the current generation (a
+            # degraded scorer beats no scorer) and surface loudly.
+            logger.error(
+                "pilot: rollback wanted (%s) but generation %s has no "
+                "predecessor in the ring; keeping it live", reason, bad)
+            flight.dump(f"pilot.rollback-impossible:gen-{bad}")
+            return {"rolled_back": False, "reason": reason}
+        faults.check("pilot.rollback")
+        model = self.ring.load(target)
+        if self.server is not None:
+            retry.call_with_retry(
+                lambda: self.server.reload(model),
+                site="pilot.rollback.reload",
+                policy=self._retry_policy(),
+            )
+            self.server.reset_breaker()
+        self.ring.mark_rolled_back(bad, to=target, reason=reason)
+        self.state.rollbacks += 1
+        self.state.last_rollback = {
+            "cycle": self.state.cycle,
+            "from_generation": bad,
+            "to_generation": target,
+            "reason": reason,
+            "at": time.time(),
+        }
+        flight.dump(f"pilot.rollback:gen-{bad}")
+        logger.warning(
+            "pilot: ROLLED BACK generation %s -> %s (%s)",
+            bad, target, reason)
+        return {
+            "rolled_back": True, "from": bad, "to": target,
+            "reason": reason,
+        }
+
+    # -- the cycle ---------------------------------------------------------
+
+    def run_cycle(self) -> dict:
+        """One supervision pass: trigger (or resume) and drive a cycle
+        to IDLE. Returns a report dict; never raises for stage
+        failures (they are recorded, committed, and retried with
+        backoff on the next pass) — only ``InjectedCrash`` and
+        BaseExceptions (signals) propagate, since they model process
+        death."""
+        from photon_tpu.resilience.errors import InjectedCrash
+
+        if self.state.mode == MODE_SERVE_ONLY:
+            return {
+                "mode": MODE_SERVE_ONLY,
+                "stage": self.state.stage,
+                "last_error": self.state.last_error,
+            }
+        if self.state.stage == "IDLE":
+            all_shards, new = self.pending_shards()
+            if not new:
+                self._export_gauges()
+                return {"stage": "IDLE", "new_shards": 0}
+            self.state.cycle += 1
+            self.state.stage = "INGEST"
+            self.state.cycle_shards = list(all_shards)
+            self.state.new_shards = list(new)
+            self.state.landed_at = self._landed_at(new)
+            self._commit()
+            logger.info(
+                "pilot: cycle %d triggered by %d new shard(s)",
+                self.state.cycle, len(new))
+        try:
+            return self._drive_cycle()
+        except InjectedCrash:
+            raise  # chaos 'crash' faults model process death
+        except Exception as exc:  # noqa: BLE001 — the supervisor
+            # outlives every failure it supervises: record, commit,
+            # back off, resume at the committed stage next pass.
+            self.state.failures += 1
+            self.state.consecutive_failures += 1
+            self.state.last_error = f"{type(exc).__name__}: {exc}"
+            self._maybe_degrade(self.state.last_error)
+            self._commit()
+            logger.exception(
+                "pilot: cycle %d failed at stage %s (failure streak "
+                "%d); will resume there after backoff",
+                self.state.cycle, self.state.stage,
+                self.state.consecutive_failures)
+            return {
+                "stage": self.state.stage,
+                "cycle": self.state.cycle,
+                "error": self.state.last_error,
+                "mode": self.state.mode,
+                "backoff_s": self.backoff_s(),
+            }
+
+    def _drive_cycle(self) -> dict:
+        report: dict = {"cycle": self.state.cycle}
+        self._cycle_overruns_baseline = self.state.deadline_overruns
+        data = None
+        candidate = init = None
+        stage = self.state.stage
+        self.state.require_stage(*STAGES[1:])
+
+        if stage in ("INGEST", "TRAIN", "VALIDATE"):
+            data, stats = self._stage_run(
+                "INGEST", "pilot.ingest", self._ingest
+            )
+            report["ingest"] = {
+                "rows": stats["rows_ingested"],
+                "quarantined": stats["shards_quarantined"],
+            }
+            if stage == "INGEST":
+                self.state.stage = stage = "TRAIN"
+                self._commit()
+
+        if stage in ("TRAIN", "VALIDATE"):
+            if stage == "TRAIN":
+                candidate, init = self._stage_run(
+                    "TRAIN", "pilot.train", lambda: self._train(data)
+                )
+                self.state.stage = stage = "VALIDATE"
+                self._commit()
+            else:
+                # Resumed directly at VALIDATE: TRAIN committed the
+                # candidate before the transition, by construction.
+                from photon_tpu.io.model_io import load_checkpoint
+
+                candidate = load_checkpoint(self._candidate_path())
+                init = self._init_model()
+
+        if stage == "VALIDATE":
+            cand_m, inc_m, reasons = self._stage_run(
+                "VALIDATE", "pilot.validate",
+                lambda: self._validate(data, candidate, init),
+            )
+            report["candidate_metrics"] = cand_m
+            report["serving_metrics"] = inc_m
+            if reasons:
+                return self._refuse(report, reasons)
+            self.state.stage = stage = "PROMOTE"
+            self._commit()
+
+        if stage == "PROMOTE":
+            if candidate is None:
+                from photon_tpu.io.model_io import load_checkpoint
+
+                candidate = load_checkpoint(self._candidate_path())
+            promoted = self._promote_with_deadline(candidate, report)
+            report["promotion"] = promoted
+            staleness = (
+                time.time() - self.state.landed_at
+                if self.state.landed_at else None
+            )
+            self.state.staleness_seconds = staleness
+            self.state.promotions += 1
+            self.state.last_promotion = {
+                "cycle": self.state.cycle,
+                "generation": promoted["generation"],
+                "values_only": promoted.get("values_only"),
+                "staleness_seconds": staleness,
+                "at": time.time(),
+            }
+            report["staleness_seconds"] = staleness
+            self.state.stage = stage = "OBSERVE"
+            self.state.last_error = None
+            self._commit()
+
+        if stage == "OBSERVE":
+            started = (self.state.last_promotion or {}).get(
+                "at", time.time()
+            )
+            baseline = self._observe_baseline()
+            verdict = self._observe(started, baseline)
+            if verdict is not None:
+                report["rollback"] = self._rollback(verdict)
+            return self._finish_cycle(report)
+        raise AssertionError(
+            f"unreachable pilot stage {stage!r}")  # pragma: no cover
+
+    def _promote_with_deadline(self, candidate, report) -> dict:
+        """PROMOTE runs its fault point inline (the ring write and the
+        post-stage window both fire ``pilot.promote`` themselves), so
+        the stage wrapper here only adds deadline bookkeeping and
+        transient retry around the reload sub-step (already wrapped)."""
+        t0 = time.monotonic()
+        out = self._promote(candidate, report.get("candidate_metrics"))
+        took = time.monotonic() - t0
+        budget = self.config.stage_deadline_s.get("promote")
+        if budget is not None and took > budget:
+            self.state.deadline_overruns += 1
+            self.state.consecutive_failures += 1
+            self._maybe_degrade(
+                f"stage PROMOTE overran its {budget:g}s deadline")
+            self._commit()
+        return out
+
+    def _refuse(self, report: dict, reasons: list[str]) -> dict:
+        from photon_tpu.obs import flight
+
+        self.state.refusals += 1
+        self.state.last_refusal = {
+            "cycle": self.state.cycle,
+            "reasons": list(reasons),
+            "candidate_metrics": report.get("candidate_metrics"),
+            "serving_metrics": report.get("serving_metrics"),
+            "at": time.time(),
+        }
+        report["refused"] = list(reasons)
+        flight.dump(f"pilot.refusal:cycle-{self.state.cycle}")
+        logger.warning(
+            "pilot: cycle %d promotion REFUSED: %s",
+            self.state.cycle, "; ".join(reasons))
+        return self._finish_cycle(report)
+
+    def _finish_cycle(self, report: dict) -> dict:
+        """Back to IDLE: the cycle's shards are processed either way
+        (a refused/rolled-back candidate still consumed the data — the
+        next cycle waits for NEW shards, it does not spin on the old)."""
+        clean = (
+            "error" not in report
+            and self.state.deadline_overruns
+            == getattr(self, "_cycle_overruns_baseline", 0)
+        )
+        self.state.processed_shards = list(self.state.cycle_shards)
+        self.state.cycle_shards = []
+        self.state.new_shards = []
+        self.state.stage = "IDLE"
+        self.state.cycles_completed += 1
+        if clean:
+            self.state.consecutive_failures = 0
+        self._commit()
+        self._prune_cycle_dirs()
+        report["stage"] = "IDLE"
+        report["mode"] = self.state.mode
+        return report
+
+    def _prune_cycle_dirs(self) -> None:
+        """Bounded disk for an always-on daemon: per-cycle work dirs
+        (ingest spills, training checkpoints, the candidate npz) are
+        deleted past ``keep_cycle_dirs``, AFTER the IDLE commit — the
+        ring holds the durable generations; cycle dirs are debugging
+        context, not recovery state, once their cycle completed."""
+        import re
+        import shutil
+
+        keep = max(int(self.config.keep_cycle_dirs), 0)
+        pat = re.compile(r"^cycle-(\d+)$")
+        found = []
+        for name in os.listdir(self.config.work_dir):
+            m = pat.match(name)
+            if m is not None:
+                found.append((int(m.group(1)), name))
+        for _, name in sorted(found)[:-keep] if keep else sorted(found):
+            shutil.rmtree(
+                os.path.join(self.config.work_dir, name),
+                ignore_errors=True,
+            )
+
+    # -- daemon loop -------------------------------------------------------
+
+    def run_forever(self, *, poll_interval_s: float = 5.0,
+                    max_cycles: int | None = None,
+                    idle_timeout_s: float | None = None,
+                    should_stop=None) -> dict:
+        """Poll -> cycle -> sleep, forever (or until ``max_cycles``
+        promotions+refusals for CI, ``idle_timeout_s`` of no new
+        shards, or ``should_stop()``). Failure backoff stretches the
+        sleep; the loop itself never raises for supervised failures."""
+        last_work = time.time()
+        cycles = 0
+        while True:
+            if should_stop is not None and should_stop():
+                return {"stopped": "requested", "cycles": cycles}
+            report = self.run_cycle()
+            if report.get("stage") == "IDLE" and "cycle" in report:
+                cycles += 1
+                last_work = time.time()
+                if max_cycles is not None and cycles >= max_cycles:
+                    return {"stopped": "max_cycles", "cycles": cycles}
+            elif "error" in report:
+                last_work = time.time()
+            elif (
+                idle_timeout_s is not None
+                and time.time() - last_work > idle_timeout_s
+            ):
+                return {"stopped": "idle", "cycles": cycles}
+            time.sleep(max(poll_interval_s, self.backoff_s())
+                       if "error" in report else poll_interval_s)
+
+    # -- observability -----------------------------------------------------
+
+    def _export_gauges(self) -> None:
+        """pilot_* registry gauges (ride /metrics via the registry
+        collector; not gated on the telemetry flag — same policy as the
+        stream gauges)."""
+        try:
+            from photon_tpu import obs
+
+            s = self.state
+            g = obs.REGISTRY.gauge
+            g("pilot_promotions_total").set(s.promotions)
+            g("pilot_rollbacks_total").set(s.rollbacks)
+            g("pilot_refusals_total").set(s.refusals)
+            g("pilot_cycles_completed_total").set(s.cycles_completed)
+            g("pilot_cycle_stage").set(STAGES.index(s.stage))
+            g("pilot_serve_only").set(
+                1.0 if s.mode == MODE_SERVE_ONLY else 0.0)
+            g("pilot_consecutive_failures").set(s.consecutive_failures)
+            g("pilot_deadline_overruns_total").set(s.deadline_overruns)
+            if s.staleness_seconds is not None:
+                g("pilot_staleness_seconds").set(s.staleness_seconds)
+            if self.ring.live is not None:
+                g("pilot_generation_live").set(self.ring.live)
+        except Exception:  # pragma: no cover — telemetry must never
+            # alter control-loop semantics.
+            logger.debug("pilot gauges unavailable", exc_info=True)
+
+    def metrics_families(self) -> list[dict]:
+        """/metrics collector (register with ``MonitorServer``): the
+        control-loop counters, the one-hot stage state-set, staleness,
+        and the live/staged generation — OBSERVABILITY.md pilot rows."""
+        from photon_tpu.obs import monitor
+
+        s = self.state
+        fams = [
+            monitor.family(
+                "pilot_cycle_events_total", "counter",
+                "control-loop outcomes by kind",
+                [
+                    ("", {"kind": "promotion"}, float(s.promotions)),
+                    ("", {"kind": "rollback"}, float(s.rollbacks)),
+                    ("", {"kind": "refusal"}, float(s.refusals)),
+                    ("", {"kind": "failure"}, float(s.failures)),
+                    ("", {"kind": "deadline_overrun"},
+                     float(s.deadline_overruns)),
+                ],
+            ),
+            monitor.state_family(
+                "pilot_cycle_stage_state", STAGES, s.stage,
+                "one-hot pilot state-machine stage",
+            ),
+            monitor.family(
+                "pilot_serve_only", "gauge",
+                "1 when the trainer degraded to serve-only mode",
+                [("", {}, 1.0 if s.mode == MODE_SERVE_ONLY else 0.0)],
+            ),
+            monitor.family(
+                "pilot_consecutive_failures", "gauge",
+                "current failed-cycle streak (backoff driver)",
+                [("", {}, float(s.consecutive_failures))],
+            ),
+        ]
+        if s.staleness_seconds is not None:
+            fams.append(monitor.family(
+                "pilot_staleness_seconds", "gauge",
+                "shard-landed -> model-serving seconds, last promotion",
+                [("", {}, float(s.staleness_seconds))],
+            ))
+        if self.ring.live is not None:
+            fams.append(monitor.family(
+                "pilot_generation_live", "gauge",
+                "ring generation currently serving",
+                [("", {}, float(self.ring.live))],
+            ))
+        return fams

@@ -1,0 +1,82 @@
+"""The pilot's in-process serving stack: tables + ladder + queue, swappable.
+
+One object owns the three serving pieces (``CoefficientTables``,
+``ScorePrograms``, ``MicroBatchQueue``) so the control loop has a single
+handle to hot-swap (``reload``), probe (``health``), and tear down
+(``close``). ``reload`` delegates to ``MicroBatchQueue.reload_model``:
+values-only refreshes flip table references under live dispatch (zero
+recompiles — the tier-2 ``pilot`` contract proves the static half);
+structure changes compile the new ladder off-path and swap under the
+queue's quiesce window. Serving is never torn down for a promotion.
+"""
+
+from __future__ import annotations
+
+
+class PilotServer:
+    """Live scorer the pilot promotes into. Thin by design: all the
+    concurrency lives in the queue; this object is just the bundle."""
+
+    def __init__(
+        self,
+        model,
+        *,
+        rungs=(1, 8, 64),
+        max_linger_s: float = 0.002,
+        slo=None,
+        breaker_threshold: int | None = None,
+        queue_kwargs: dict | None = None,
+    ):
+        from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+        from photon_tpu.serve.queue import MicroBatchQueue
+        from photon_tpu.serve.tables import CoefficientTables
+
+        self.tables = CoefficientTables.from_game_model(model)
+        self.programs = ScorePrograms(
+            self.tables, ladder=ShapeLadder(tuple(rungs))
+        )
+        self.queue = MicroBatchQueue(
+            self.programs,
+            max_linger_s=max_linger_s,
+            slo=slo,
+            breaker_threshold=breaker_threshold,
+            **(queue_kwargs or {}),
+        )
+
+    #: compile-cache events observed across every ``reload`` — the
+    #: runtime half of the zero-recompile promotion claim (a values-only
+    #: swap must leave it flat; the tier-2 ``pilot`` contract is the
+    #: static half). Only moves while the persistent compile cache's
+    #: monitoring listener is installed (``enable_compilation_cache``).
+    reload_compile_events: int = 0
+
+    def reload(self, model) -> dict:
+        from photon_tpu.utils import compile_event_count
+
+        before = compile_event_count()
+        out = self.queue.reload_model(model)
+        # A structure-changing swap rebuilt the ladder: track the live
+        # programs object so submit-side helpers (synthetic traffic)
+        # read the current generation's specs.
+        self.programs = self.queue.programs
+        out["compile_events"] = compile_event_count() - before
+        self.reload_compile_events += out["compile_events"]
+        return out
+
+    def submit(self, features, entity_ids=None, **kw):
+        return self.queue.submit(features, entity_ids, **kw)
+
+    def health(self) -> dict:
+        return self.queue.health()
+
+    def reset_breaker(self) -> None:
+        self.queue.reset_breaker()
+
+    def close(self, timeout: float | None = None) -> bool:
+        return self.queue.close(timeout)
+
+    def __enter__(self) -> "PilotServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(self.queue.close_timeout_s)

@@ -1,0 +1,122 @@
+"""The pilot's durable state machine: every transition is a committed fact.
+
+The supervisor's whole crash-safety story reduces to one rule: the ONLY
+authority on where a cycle stands is ``pilot-state.json``, and it only
+ever changes through the same atomic tmp+fsync+rename dance every other
+durable artifact in this repo uses (``io/model_io.atomic_write_bytes``).
+A killed pilot restarted against the same work dir reads the committed
+stage and resumes exactly there — mid-TRAIN resumes through the
+training checkpointer, mid-PROMOTE re-promotes the staged generation,
+mid-OBSERVE re-opens the observation window.
+
+Stage graph (one cycle)::
+
+    IDLE -> INGEST -> TRAIN -> VALIDATE -> PROMOTE -> OBSERVE -> IDLE
+                                  |                      |
+                                  v (gate refusal)       v (SLO burn)
+                                IDLE                 ROLLBACK -> IDLE
+
+ROLLBACK is not a committed stage of its own: it executes inside the
+OBSERVE stage's transition back to IDLE, under the ``pilot.rollback``
+fault point, so a crash mid-rollback resumes at OBSERVE and re-decides
+(the burn evidence is re-read from the live queue, and re-running a
+rollback whose ring commit already landed is a no-op).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+SCHEMA_VERSION = 1
+STATE_FILE = "pilot-state.json"
+
+# Committed stages, in cycle order. The numeric index doubles as the
+# ``pilot_cycle_stage`` gauge value (obs/monitor.py state_family renders
+# the one-hot labeled form next to it).
+STAGES = ("IDLE", "INGEST", "TRAIN", "VALIDATE", "PROMOTE", "OBSERVE")
+
+MODE_ACTIVE = "active"
+MODE_SERVE_ONLY = "serve-only"
+
+
+@dataclasses.dataclass
+class PilotState:
+    """Everything a restarted pilot needs to continue mid-cycle."""
+
+    stage: str = "IDLE"
+    cycle: int = 0
+    mode: str = MODE_ACTIVE
+    # Shard bookkeeping: ``processed_shards`` is the set already trained
+    # into a PROMOTED (or refused) generation; ``cycle_shards`` is the
+    # in-flight cycle's FROZEN snapshot (processed + new, in manifest
+    # order) and ``new_shards`` the delta that triggered the cycle.
+    processed_shards: list = dataclasses.field(default_factory=list)
+    cycle_shards: list = dataclasses.field(default_factory=list)
+    new_shards: list = dataclasses.field(default_factory=list)
+    # Wall-clock instant the cycle's newest shard landed (mtime max) —
+    # the zero point of the staleness metric.
+    landed_at: float | None = None
+    # Degradation accounting.
+    consecutive_failures: int = 0
+    deadline_overruns: int = 0
+    failures: int = 0
+    last_error: str | None = None
+    # Control-loop totals (restart-durable; the pilot_* gauges read
+    # these, so a supervisor restart never zeroes the counters).
+    cycles_completed: int = 0
+    promotions: int = 0
+    rollbacks: int = 0
+    refusals: int = 0
+    last_refusal: dict | None = None
+    last_promotion: dict | None = None
+    last_rollback: dict | None = None
+    staleness_seconds: float | None = None
+    updated_at: float = 0.0
+    schema_version: int = SCHEMA_VERSION
+
+    def require_stage(self, *allowed: str) -> None:
+        if self.stage not in allowed:
+            raise ValueError(
+                f"pilot state machine: stage {self.stage!r} is not one "
+                f"of {allowed}")
+
+
+def state_path(work_dir: str) -> str:
+    return os.path.join(work_dir, STATE_FILE)
+
+
+def commit_state(work_dir: str, state: PilotState) -> None:
+    """Atomically commit ``state`` — THE transition primitive. A pilot
+    killed at any instant leaves either the previous committed stage or
+    the new one, never a torn file."""
+    from photon_tpu.io.model_io import atomic_write_bytes
+
+    os.makedirs(work_dir, exist_ok=True)
+    state.updated_at = time.time()
+    payload = dataclasses.asdict(state)
+    atomic_write_bytes(
+        state_path(work_dir),
+        json.dumps(payload, indent=2, sort_keys=True).encode("utf-8"),
+    )
+
+
+def load_state(work_dir: str) -> PilotState | None:
+    """Read the committed state, or None for a fresh work dir. A state
+    file from a future schema refuses loudly rather than guessing."""
+    path = state_path(work_dir)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        raw = json.load(f)
+    version = raw.pop("schema_version", None)
+    if version != SCHEMA_VERSION:
+        raise ValueError(
+            f"pilot state {path}: schema_version {version!r} is not the "
+            f"supported {SCHEMA_VERSION}")
+    known = {f.name for f in dataclasses.fields(PilotState)}
+    state = PilotState(**{k: v for k, v in raw.items() if k in known})
+    state.require_stage(*STAGES)
+    return state

@@ -32,6 +32,17 @@ point               boundary
                     ``data/stream.py``)
 ``io.shard_decode`` streaming-ingest shard DECODE (Avro container ->
                     window arrays, ``data/stream.py``)
+``pilot.ingest``    pilot INGEST stage (the supervisor's streamed ingest
+                    of a cycle's shard snapshot, ``pilot/loop.py``)
+``pilot.train``     pilot TRAIN stage (warm-start retrain under the
+                    training checkpointer)
+``pilot.validate``  pilot VALIDATE stage (candidate-vs-serving
+                    evaluation, BEFORE the promotion gate decides)
+``pilot.promote``   pilot PROMOTE stage, AFTER the new generation's ring
+                    commit but BEFORE the serving ``reload()`` commit —
+                    the kill-during-promotion window
+``pilot.rollback``  pilot ROLLBACK (SLO-burn-triggered revert to the
+                    previous ring generation)
 ==================  ======================================================
 
 Fault kinds (``FaultSpec.error``): ``"transient"`` raises
@@ -97,6 +108,11 @@ INJECTION_POINTS = (
     "cd.iteration",
     "io.shard_read",
     "io.shard_decode",
+    "pilot.ingest",
+    "pilot.train",
+    "pilot.validate",
+    "pilot.promote",
+    "pilot.rollback",
 )
 
 _KINDS = ("transient", "poison", "crash", "delay", "sigterm")

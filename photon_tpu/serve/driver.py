@@ -107,6 +107,88 @@ def dataset_requests(
     return reqs
 
 
+def traffic_loop(
+    get_server,
+    rate: float,
+    stop,
+    counts: dict,
+    *,
+    batch: int = 32,
+    cold_fraction: float = 0.05,
+    idle_sleep: float = 0.05,
+    drain_timeout_s: float = 30.0,
+) -> None:
+    """Open-ended paced synthetic traffic against a LIVE server — the
+    load generator the pilot's CLI (``--traffic-qps``) and the bench's
+    pilot replay run on their own thread for a whole supervision run,
+    so every promotion happens UNDER traffic.
+
+    ``get_server()`` returns the current server-like object (anything
+    with ``.programs`` and ``.submit``; ``PilotServer``) or None while
+    serving is not yet up; it is re-read every ``batch`` requests so a
+    hot-swapped generation is picked up. ``stop`` is a
+    ``threading.Event``; ``counts`` (``served`` / ``errors`` /
+    ``submit_errors`` / ``stranded`` / ``last_error``) is mutated ONLY
+    from the calling thread — read it after the join. Typed queue
+    rejections (shed/breaker/closed) are counted, never fatal: the
+    generator outlives degraded mode. This function owns no threads and
+    no locks — the CALLER spawns the thread, matching the driver's
+    threading model."""
+    interval = 1.0 / rate
+    next_t = time.perf_counter()
+    pending: list = []
+    batch_no = 0
+    while not stop.is_set():
+        server = get_server()
+        if server is None:
+            time.sleep(idle_sleep)
+            continue
+        programs = server.programs
+        try:
+            reqs = synthetic_requests(
+                programs.tables, programs, batch,
+                cold_fraction=cold_fraction, seed=batch_no,
+            )
+        except Exception:  # pragma: no cover — mid-swap shapes race;
+            # the next iteration reads the settled generation.
+            time.sleep(0.01)
+            continue
+        batch_no += 1
+        for feats, ids in reqs:
+            if stop.is_set():
+                break
+            delay = next_t - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            next_t = max(
+                next_t + interval, time.perf_counter() - 5 * interval
+            )
+            try:
+                pending.append(server.submit(feats, ids))
+            except Exception as exc:  # noqa: BLE001 — typed queue
+                # rejections count as drops; zero-drop gates want them.
+                counts["submit_errors"] += 1
+                counts["last_error"] = type(exc).__name__
+            while pending and pending[0].done():
+                fut = pending.pop(0)
+                if fut.exception() is None:
+                    counts["served"] += 1
+                else:
+                    counts["errors"] += 1
+                    counts["last_error"] = type(fut.exception()).__name__
+    for fut in pending:
+        try:
+            exc = fut.exception(timeout=drain_timeout_s)
+        except TimeoutError:
+            counts["stranded"] += 1
+            continue
+        if exc is None:
+            counts["served"] += 1
+        else:
+            counts["errors"] += 1
+            counts["last_error"] = type(exc).__name__
+
+
 def drive(
     queue: MicroBatchQueue,
     requests: list[tuple[dict, dict]],

@@ -44,6 +44,13 @@ out, and a retry's backoff does stack onto that batch's latency. Pass
 - **health()**: one locked snapshot — queue depth, shed / deadline /
   error / retry / breaker counters, coefficient-table generation — the
   CLI and bench surface it.
+- **reload_model() / quiesce()**: hot model swap on the LIVE queue — a
+  values-only refresh flips table references with dispatch running; a
+  structure change compiles the new generation's ladder off-path, then
+  swaps tables and the queue's program binding inside one ``quiesce``
+  window (the worker parks before popping; producers keep queueing, no
+  request is dropped). The pilot's promotion path and ``cli.serve
+  --reload-model`` both ride this.
 
 Request-scoped tracing (``photon_tpu.obs.trace``): with telemetry
 enabled, every ``submit`` mints a process-unique request id and every
@@ -69,6 +76,7 @@ serving subsequent batches).
 from __future__ import annotations
 
 import collections
+import contextlib
 import itertools
 import logging
 import threading
@@ -112,6 +120,11 @@ CONCURRENCY_AUDIT = dict(
             "MicroBatchQueue._consecutive_failures",
             "MicroBatchQueue._has_deadlines",
             "MicroBatchQueue._close_stranded",
+            "MicroBatchQueue._paused",
+            "MicroBatchQueue._dispatching",
+            "MicroBatchQueue.programs",
+            "MicroBatchQueue._re_types",
+            "MicroBatchQueue.hotness",
         ),
         "_Future._lock": (
             "_Future._callbacks",
@@ -330,6 +343,12 @@ class MicroBatchQueue:
         self._close_stranded = False
         self._breaker_open = False
         self._consecutive_failures = 0
+        # Quiesce state (``quiesce()`` / ``reload_model``): while
+        # ``_paused`` the worker parks BEFORE popping a batch;
+        # ``_dispatching`` is True from batch pop to dispatch return so
+        # the quiescer can wait out an in-flight batch.
+        self._paused = False
+        self._dispatching = False
         # Latched on the first deadline-bearing submit so the worker's
         # expiry scan stays off the clean path entirely.
         self._has_deadlines = default_deadline_s is not None
@@ -377,6 +396,7 @@ class MicroBatchQueue:
             window_s=latency_window_s, num_windows=latency_windows
         )
         self.slo_tracker = None if slo is None else SloTracker(slo)
+        self._hotness_k = int(hotness_k)
         self.hotness = {
             name: SpaceSavingSketch(hotness_k)
             for name in random_tables
@@ -513,6 +533,96 @@ class MicroBatchQueue:
             self._breaker_open = False
             self._consecutive_failures = 0
             self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def quiesce(self):
+        """Pause dispatch for the duration of the block — the swap
+        window ``CoefficientTables.rebuild_from`` needs.
+
+        Entering waits out any in-flight batch; while held, the worker
+        parks BEFORE popping (no request is dispatched, none is
+        dropped — producers keep queueing against the normal
+        backpressure bound). Exiting resumes dispatch. Not reentrant;
+        ``close()`` overrides a held pause so shutdown still drains."""
+        with self._cond:
+            self._paused = True
+            while self._dispatching:
+                self._cond.wait()
+        try:
+            yield self
+        finally:
+            with self._cond:
+                self._paused = False
+                self._cond.notify_all()
+
+    def _adopt_programs_locked(self, programs) -> None:
+        """Rebind the queue to a new generation's ``ScorePrograms``
+        (caller holds ``_cond`` AND the quiesce pause — the worker is
+        parked, so no dispatch can straddle generations). Per-coordinate
+        counters and hotness sketches carry over where the coordinate
+        survives the structure change and start fresh where it doesn't."""
+        from photon_tpu.obs.monitor import SpaceSavingSketch
+
+        self.programs = programs  # photon: ignore[unlocked-shared-write] -- reload_model's adopt callback holds _cond (the _locked suffix is the calling convention)
+        self.max_batch = min(self.max_batch, programs.ladder.max_batch)
+        random_tables = getattr(
+            getattr(programs, "tables", None), "random", None
+        ) or {}
+        self._coord_stats = {  # photon: ignore[unlocked-shared-write] -- reload_model's adopt callback holds _cond (see docstring)
+            name: self._coord_stats.get(
+                name, {"entity_lookups": 0, "cold_lookups": 0}
+            )
+            for name in random_tables
+        }
+        self._re_types = {  # photon: ignore[unlocked-shared-write] -- same: caller holds _cond
+            name: t.random_effect_type
+            for name, t in random_tables.items()
+        }
+        self.hotness = {  # photon: ignore[unlocked-shared-write] -- same: caller holds _cond
+            name: self.hotness.get(name)
+            or SpaceSavingSketch(self._hotness_k)
+            for name in random_tables
+        }
+
+    def reload_model(self, model) -> dict:
+        """Hot-swap a refreshed ``GameModel`` into the LIVE queue.
+
+        Values-only delta (the daily-retrain case): the tables' in-place
+        reference swap — safe against live dispatch, zero recompiles,
+        no pause. Structure change: the full ``rebuild_from`` dance —
+        new tables + AOT ladder compiled off-path while the old
+        generation keeps serving, then tables AND the queue's program
+        binding swap inside one ``quiesce`` window. Either way no
+        queued request is dropped. Returns
+        ``{"values_only", "generation", "programs_compiled"}``."""
+        from photon_tpu.serve.tables import CoefficientTables
+
+        tables = self.programs.tables
+        new = CoefficientTables.from_game_model(model)
+        if tables._values_only_delta(new):
+            tables._reload_built(new)
+            return {
+                "values_only": True,
+                "generation": tables.generation,
+                "programs_compiled": 0,
+            }
+
+        def adopt(new_programs):
+            with self._cond:
+                self._adopt_programs_locked(new_programs)
+
+        new_programs = tables.rebuild_from(
+            model,
+            programs=self.programs,
+            quiesce=self.quiesce,
+            adopt=adopt,
+            prebuilt=new,
+        )
+        return {
+            "values_only": False,
+            "generation": tables.generation,
+            "programs_compiled": new_programs.stats["programs_compiled"],
+        }
 
     def __enter__(self) -> "MicroBatchQueue":
         return self
@@ -754,6 +864,12 @@ class MicroBatchQueue:
         """
         with self._cond:
             while True:
+                # Quiesced: park WITHOUT popping — requests keep
+                # queueing (backpressure holds) while reload_model
+                # swaps the program generation. close() overrides the
+                # pause so a quiesced queue still drains on shutdown.
+                while self._paused and not self._closed:
+                    self._cond.wait()
                 expired = self._expire_locked()
                 if self._pending:
                     linger_end = (
@@ -762,6 +878,7 @@ class MicroBatchQueue:
                     while (
                         len(self._pending) < self.max_batch
                         and not self._closed
+                        and not self._paused
                     ):
                         # The linger is cut short by request deadlines:
                         # a deadline that would lapse mid-linger flushes
@@ -785,6 +902,21 @@ class MicroBatchQueue:
                         if remaining <= 0:
                             break
                         self._cond.wait(timeout=remaining)
+                    # A quiesce can begin WHILE the worker lingers (the
+                    # pause check at the loop top is behind us): popping
+                    # now would dispatch the old ladder against a
+                    # mid-swap table generation. Re-park before taking
+                    # anything — the pop below must only ever run with
+                    # the pause flag observed clear under this lock.
+                    # Already-pulled expirations are handed back first
+                    # (their futures must resolve, pause or not).
+                    if self._paused and not self._closed:
+                        if expired:
+                            return (
+                                [], expired,
+                                len(self._pending), self._breaker_open,
+                            )
+                        continue
                     # Deadlines may have lapsed during the linger wait;
                     # a request must never reach dispatch already dead.
                     expired.extend(self._expire_locked())
@@ -797,6 +929,11 @@ class MicroBatchQueue:
                     if batch:
                         self._stats["batches"] += 1
                         self._stats["batched_requests"] += len(batch)
+                        # Pinned under the SAME lock hold that popped
+                        # the batch: a quiescer entering now waits for
+                        # this dispatch to finish — there is no window
+                        # where a popped batch is invisible to quiesce.
+                        self._dispatching = True
                         from photon_tpu import obs
 
                         if obs.enabled():
@@ -850,7 +987,12 @@ class MicroBatchQueue:
             if batch is None:
                 return
             if batch:
-                self._dispatch(batch)
+                try:
+                    self._dispatch(batch)
+                finally:
+                    with self._cond:
+                        self._dispatching = False
+                        self._cond.notify_all()
 
     def _dispatch(self, batch: list[_Request]) -> None:
         """Pad, score, scatter — outside the lock (producers keep

@@ -20,7 +20,10 @@ safe against live dispatch (in-flight batches pin the old generation),
 ``donate=True`` writes the new values into the OLD buffers' HBM via a
 donating jitted copy for memory-constrained QUIESCED reloads; a
 structure change (new entities, new coordinates) rebuilds the tables
-and the caller must rebuild its programs.
+and the caller must rebuild its programs — ``rebuild_from`` does both
+in one move (new tables + new AOT ladder off-path, swap under a
+caller-supplied quiesce), which is how the pilot's structure-changing
+promotions and ``MicroBatchQueue.reload_model`` stay zero-downtime.
 """
 
 from __future__ import annotations
@@ -269,7 +272,17 @@ class CoefficientTables:
         under live dispatch (quiesce first), and the caller must
         rebuild its score programs if shapes changed.
         """
-        new = CoefficientTables.from_game_model(model)
+        return self._reload_built(
+            CoefficientTables.from_game_model(model), donate=donate
+        )
+
+    def _reload_built(
+        self, new: "CoefficientTables", *, donate: bool = False
+    ) -> bool:
+        """``reload`` against an ALREADY-BUILT new-generation tables
+        object — callers that needed the structure answer before
+        deciding how to swap (``MicroBatchQueue.reload_model``) avoid a
+        second ``from_game_model`` device upload."""
         self.generation += 1
         if not self._values_only_delta(new):
             self.fixed = new.fixed
@@ -292,6 +305,67 @@ class CoefficientTables:
             t.task = src.task
         self.task = new.task
         return True
+
+    def rebuild_from(
+        self,
+        model: GameModel,
+        *,
+        programs=None,
+        quiesce=None,
+        adopt=None,
+        prebuilt: "CoefficientTables | None" = None,
+    ):
+        """Structure-changing reload, fully orchestrated.
+
+        ``reload()`` returning False used to leave callers to rebuild
+        the score ladder by hand; this does the whole dance: the new
+        generation's tables — and, when ``programs`` (the live
+        ``ScorePrograms``) is given, a freshly AOT-compiled ladder with
+        the same rungs — are built OFF-PATH while the old generation
+        keeps serving, then the swap happens inside ``quiesce`` (a
+        context-manager factory, e.g. ``MicroBatchQueue.quiesce`` —
+        None means the caller guarantees no live dispatch). ``adopt``,
+        when given, is called with the new ``ScorePrograms`` INSIDE the
+        quiesce window so a dispatch loop can rebind its program
+        reference before traffic resumes (``reload_model`` wires it).
+
+        A values-only delta short-circuits to the in-place ``reload``
+        swap (no quiesce taken, no programs built) and returns None;
+        otherwise returns the new ``ScorePrograms`` (or None when
+        ``programs`` was None), rebound to THIS tables object so future
+        dispatches read the live generation.
+        """
+        import contextlib
+
+        new = (
+            prebuilt if prebuilt is not None
+            else CoefficientTables.from_game_model(model)
+        )
+        if self._values_only_delta(new):
+            self._reload_built(new)
+            return None
+        new_programs = None
+        if programs is not None:
+            from photon_tpu.serve.programs import ScorePrograms
+
+            # Compile against the new generation's shapes while the old
+            # ladder keeps dispatching — the expensive step stays off
+            # the serving path.
+            new_programs = ScorePrograms(new, ladder=programs.ladder)
+        ctx = quiesce() if quiesce is not None else contextlib.nullcontext()
+        with ctx:
+            self.generation += 1
+            self.fixed = new.fixed
+            self.random = new.random
+            self.task = new.task
+            if new_programs is not None:
+                # Rebind to the LIVE tables object: the swapped dicts
+                # are the very ones the new ladder was compiled
+                # against, so operand shapes cannot disagree.
+                new_programs.tables = self
+            if adopt is not None:
+                adopt(new_programs)
+        return new_programs
 
 
 def build_index_maps_from_model(model_dir: str) -> dict[str, IndexMap]:

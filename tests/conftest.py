@@ -49,3 +49,21 @@ def mesh(devices):
 @pytest.fixture
 def rng():
     return np.random.default_rng(20260729)
+
+
+@pytest.fixture(autouse=True)
+def _clean_retry_stats():
+    """Zero the process-global retry counters before every test.
+
+    The retry layer's stats dict (``resilience.retry.retry_stats``) is
+    process-global by design — production reads it as a health surface —
+    which in a test process means one test's injected transients leak
+    into the next test's "clean run records zero retries" assertion.
+    PRs 8/10 hand-reset it from individual tests; this fixture is that
+    idiom factored into the harness: every test STARTS from zero, and
+    tests that assert on accumulation within themselves are unaffected.
+    """
+    from photon_tpu.resilience.retry import reset_retry_stats
+
+    reset_retry_stats()
+    yield

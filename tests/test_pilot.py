@@ -1,0 +1,829 @@
+"""photon_tpu.pilot: the control loop survives every failure it supervises.
+
+Covers the atomic state machine (kill at any stage → resume at the
+committed stage), the promotion gate (refusal with recorded reasons +
+flight post-mortem), SLO-burn auto-rollback through the generation
+ring, the bounded ring itself, the serve-layer quiesce/rebuild path the
+pilot promotes through, and the real-subprocess kill-during-promotion
+window (SIGTERM between the generation's ring commit and the serving
+reload — the server must stay on the old generation and the pilot must
+resume mid-PROMOTE).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from photon_tpu import optim
+from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
+from photon_tpu.data.random_effect import RandomEffectDataConfiguration
+from photon_tpu.estimators.game_estimator import (
+    FixedEffectCoordinateConfiguration,
+    GameEstimator,
+    RandomEffectCoordinateConfiguration,
+)
+from photon_tpu.evaluation.evaluators import EvaluatorSpec
+from photon_tpu.io.avro_data import write_training_examples
+from photon_tpu.pilot import (
+    GenerationRing,
+    MODE_SERVE_ONLY,
+    ObservePolicy,
+    Pilot,
+    PilotConfig,
+    PilotServer,
+    PilotState,
+    PromotionGate,
+    load_state,
+)
+from photon_tpu.pilot.state import commit_state
+from photon_tpu.resilience import FaultPlan, InjectedCrash, faults
+from photon_tpu.resilience.errors import CorruptModelError, PoisonError
+from photon_tpu.types import DELIMITER, TaskType
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+USERS, FEATS = 4, 4
+COVER = [[0, 1, 2], [1, 2, 3], [0, 2, 3], [0, 1, 3]]
+
+
+def write_day(shard_dir, day: int, seed: int | None = None) -> None:
+    """One day's shard: every user's support saturates on every day
+    (fixed feature triples over the 4-feature universe), so retrains
+    stay values-only — the steady state the zero-recompile tests pin."""
+    os.makedirs(shard_dir, exist_ok=True)
+    rng = np.random.default_rng(100 + (seed if seed is not None else day))
+    rows, y, meta = [], [], []
+    for u in range(USERS):
+        for fs in COVER:
+            vals = rng.normal(size=len(fs))
+            rows.append([
+                (f"f{j}{DELIMITER}t", float(v))
+                for j, v in zip(fs, vals)
+            ])
+            z = float(vals.sum()) * 0.5
+            y.append(float(rng.uniform() < 1.0 / (1.0 + np.exp(-z))))
+            meta.append({"userId": f"u{u}"})
+    write_training_examples(
+        os.path.join(shard_dir, f"part-{day:03d}.avro"),
+        np.array(y), rows, metadata=meta,
+    )
+
+
+def make_estimator():
+    def l2(w):
+        return GLMOptimizationConfiguration(
+            regularization=optim.RegularizationContext(
+                optim.RegularizationType.L2),
+            regularization_weight=w,
+        )
+
+    return GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {
+            "global": FixedEffectCoordinateConfiguration(
+                "features", l2(1e-2)),
+            "per-user": RandomEffectCoordinateConfiguration(
+                RandomEffectDataConfiguration("userId", "features"),
+                l2(1.0),
+            ),
+        },
+        num_iterations=1,
+        evaluators=["AUC"],
+        mesh="off",
+    )
+
+
+def make_config(tmp_path, **overrides) -> PilotConfig:
+    defaults = dict(
+        stream_dir=str(tmp_path / "shards"),
+        work_dir=str(tmp_path / "work"),
+        estimator_factory=make_estimator,
+        keep_generations=3,
+        gate=PromotionGate(min_delta={"AUC": -1.0}),
+        observe=ObservePolicy(window_s=0.0),
+        backoff_base_s=0.01,
+    )
+    defaults.update(overrides)
+    return PilotConfig(**defaults)
+
+
+def make_server(model):
+    return PilotServer(model, rungs=(1, 4), max_linger_s=0.001)
+
+
+@pytest.fixture
+def pilot_env(tmp_path):
+    write_day(tmp_path / "shards", 0)
+    return tmp_path
+
+
+# --------------------------------------------------------------------------
+# state machine + ring units
+# --------------------------------------------------------------------------
+
+
+class TestStateFile:
+    def test_roundtrip(self, tmp_path):
+        state = PilotState(stage="TRAIN", cycle=3, promotions=2,
+                          processed_shards=["a", "b"])
+        commit_state(str(tmp_path), state)
+        loaded = load_state(str(tmp_path))
+        assert loaded.stage == "TRAIN"
+        assert loaded.cycle == 3
+        assert loaded.promotions == 2
+        assert loaded.processed_shards == ["a", "b"]
+
+    def test_missing_is_none(self, tmp_path):
+        assert load_state(str(tmp_path)) is None
+
+    def test_future_schema_refused(self, tmp_path):
+        state = PilotState()
+        commit_state(str(tmp_path), state)
+        path = tmp_path / "pilot-state.json"
+        doc = json.loads(path.read_text())
+        doc["schema_version"] = 99
+        path.write_text(json.dumps(doc))
+        with pytest.raises(ValueError, match="schema_version"):
+            load_state(str(tmp_path))
+
+
+def _tiny_model(scale: float = 1.0):
+    import jax.numpy as jnp
+
+    from photon_tpu.models.game import FixedEffectModel, GameModel
+    from photon_tpu.models.glm import (
+        Coefficients,
+        GeneralizedLinearModel,
+    )
+
+    rng = np.random.default_rng(5)
+    return GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(means=jnp.asarray(
+                    scale * rng.normal(size=3).astype(np.float32))),
+                TaskType.LOGISTIC_REGRESSION,
+            ),
+            "features",
+        ),
+    })
+
+
+class TestGenerationRing:
+    def test_stage_commit_rollback_and_bound(self, tmp_path):
+        ring = GenerationRing(str(tmp_path), keep=2)
+        gens = []
+        for i in range(4):
+            g = ring.stage_candidate(
+                _tiny_model(float(i + 1)), cycle=i + 1)
+            assert ring.staged == g
+            ring.commit_live(g)
+            assert ring.live == g
+            assert ring.staged is None
+            gens.append(g)
+        # Bounded: only `keep` newest survive, files pruned with them.
+        assert len(ring.entries()) == 2
+        npzs = [p for p in os.listdir(tmp_path) if p.endswith(".npz")]
+        assert len(npzs) == 2
+        # Rollback: previous() targets the newest un-rolled-back older
+        # generation; the abandoned one is marked, live flips back.
+        prev = ring.previous(ring.live)
+        assert prev == gens[-2]
+        ring.mark_rolled_back(gens[-1], to=prev, reason="slo burn")
+        assert ring.live == prev
+        bad = [e for e in ring.entries() if e["gen"] == gens[-1]][0]
+        assert bad["rolled_back"] and bad["rollback_reason"] == "slo burn"
+        # A rolled-back generation is never a rollback target again.
+        assert ring.previous(gens[-1]) == prev
+
+    def test_load_verifies_hash(self, tmp_path):
+        ring = GenerationRing(str(tmp_path), keep=2)
+        g = ring.stage_candidate(_tiny_model(), cycle=1)
+        ring.commit_live(g)
+        path = ring.path(g)
+        with open(path, "r+b") as f:
+            f.seek(0)
+            f.write(b"\x00\x00\x00\x00")
+        with pytest.raises(CorruptModelError, match="sha256"):
+            ring.load(g)
+
+    def test_keep_floor(self, tmp_path):
+        with pytest.raises(ValueError, match="keep"):
+            GenerationRing(str(tmp_path), keep=1)
+
+
+class TestPromotionGate:
+    def test_direction_aware_deltas(self):
+        specs = [EvaluatorSpec.parse("AUC"), EvaluatorSpec.parse("RMSE")]
+        gate = PromotionGate(min_delta={"AUC": 0.0, "RMSE": 0.01})
+        # AUC up + RMSE down by enough: promote.
+        assert gate.decide(
+            specs, {"AUC": 0.8, "RMSE": 0.40}, {"AUC": 0.7, "RMSE": 0.42}
+        ) == []
+        # RMSE improved by less than the demanded 0.01: refuse, with
+        # the reason naming metric, delta, and both values.
+        reasons = gate.decide(
+            specs, {"AUC": 0.8, "RMSE": 0.415},
+            {"AUC": 0.7, "RMSE": 0.42},
+        )
+        assert len(reasons) == 1 and "RMSE" in reasons[0]
+        assert "0.415" in reasons[0] and "0.42" in reasons[0]
+
+    def test_negative_delta_is_an_allowance(self):
+        specs = [EvaluatorSpec.parse("AUC")]
+        gate = PromotionGate(min_delta={"AUC": -0.05})
+        assert gate.decide(specs, {"AUC": 0.66}, {"AUC": 0.70}) == []
+        assert gate.decide(specs, {"AUC": 0.60}, {"AUC": 0.70}) != []
+
+    def test_primary_gated_by_default(self):
+        specs = [EvaluatorSpec.parse("AUC")]
+        gate = PromotionGate()
+        assert gate.decide(specs, {"AUC": 0.69}, {"AUC": 0.70}) != []
+
+    def test_missing_gated_metric_refuses(self):
+        specs = [EvaluatorSpec.parse("AUC")]
+        gate = PromotionGate(min_delta={"LOGISTIC_LOSS": 0.0})
+        reasons = gate.decide(specs, {"AUC": 0.8}, {"AUC": 0.7})
+        assert any("LOGISTIC_LOSS" in r for r in reasons)
+
+
+# --------------------------------------------------------------------------
+# the cycle
+# --------------------------------------------------------------------------
+
+
+class TestPilotCycle:
+    def test_bootstrap_then_values_only_promotion(self, pilot_env):
+        cfg = make_config(pilot_env)
+        pilot = Pilot(cfg, server_factory=make_server)
+        r1 = pilot.run_cycle()
+        assert r1["promotion"]["generation"] == 1
+        assert pilot.ring.live == 1
+        assert pilot.state.promotions == 1
+        assert r1["staleness_seconds"] is not None
+        # Nothing new: the pilot idles instead of re-training.
+        assert pilot.run_cycle() == {"stage": "IDLE", "new_shards": 0}
+        # Day 2 lands: warm-start retrain, VALUES-ONLY hot reload (the
+        # pinned vocabulary + saturated supports keep the structure,
+        # so the compiled ladder survives the promotion untouched).
+        write_day(pilot_env / "shards", 1)
+        before_programs = pilot.server.programs.stats[
+            "programs_compiled"]
+        r2 = pilot.run_cycle()
+        assert r2["promotion"]["values_only"] is True
+        assert r2["promotion"]["programs_compiled"] == 0
+        assert pilot.server.programs.stats["programs_compiled"] \
+            == before_programs
+        assert pilot.server.reload_compile_events == 0
+        assert pilot.ring.live == 2
+        # The live queue serves the new generation without a restart.
+        reqs = _requests_for(pilot.server, 3)
+        for feats, ids in reqs:
+            assert isinstance(
+                pilot.server.submit(feats, ids).result(timeout=10.0),
+                float,
+            )
+        assert pilot.state.processed_shards == [
+            "part-000.avro", "part-001.avro"]
+        pilot.server.close()
+
+    def test_gate_refusal_records_reasons_and_postmortem(
+        self, pilot_env, tmp_path
+    ):
+        from photon_tpu.obs import flight
+
+        cfg = make_config(
+            pilot_env,
+            gate=PromotionGate(min_delta={"AUC": 10.0}),  # unmeetable
+        )
+        pilot = Pilot(cfg, server_factory=make_server)
+        pilot.run_cycle()  # bootstrap auto-passes (no incumbent)
+        assert pilot.state.promotions == 1
+        write_day(pilot_env / "shards", 1)
+        flight_dir = tmp_path / "flight"
+        rec = flight.install(str(flight_dir), signals=False)
+        try:
+            r = pilot.run_cycle()
+        finally:
+            flight.uninstall()
+            assert rec is not None
+        assert r["refused"] and "AUC" in r["refused"][0]
+        assert pilot.state.refusals == 1
+        assert pilot.state.promotions == 1
+        assert pilot.ring.live == 1  # old generation keeps serving
+        assert pilot.state.last_refusal["reasons"] == r["refused"]
+        # The refusal left a flight-recorder post-mortem.
+        dumps = list(flight_dir.glob("flight-*.json"))
+        assert dumps, "refusal must dump a post-mortem"
+        # The refused cycle still consumed its shards: no retrigger.
+        assert pilot.run_cycle() == {"stage": "IDLE", "new_shards": 0}
+        pilot.server.close()
+
+    def test_cycle_dirs_pruned(self, pilot_env):
+        cfg = make_config(pilot_env, keep_cycle_dirs=1)
+        pilot = Pilot(cfg, server_factory=make_server)
+        for day in range(3):
+            if day:
+                write_day(pilot_env / "shards", day)
+            assert "promotion" in pilot.run_cycle()
+        dirs = sorted(
+            p.name for p in (pilot_env / "work").glob("cycle-*"))
+        assert dirs == ["cycle-00003"], dirs
+        pilot.server.close()
+
+    def test_validation_dir_gates_on_holdout(self, pilot_env):
+        # A held-out stream: same universe, different draws — the gate
+        # scores BOTH models on it instead of the candidate's own
+        # training data.
+        write_day(pilot_env / "holdout", 0, seed=77)
+        cfg = make_config(
+            pilot_env, validation_dir=str(pilot_env / "holdout"))
+        pilot = Pilot(cfg, server_factory=make_server)
+        r1 = pilot.run_cycle()
+        assert "promotion" in r1 and r1["candidate_metrics"]["AUC"] > 0
+        write_day(pilot_env / "shards", 1)
+        r2 = pilot.run_cycle()
+        assert "promotion" in r2
+        assert r2["serving_metrics"] is not None
+        # The holdout was streamed into the cycle's own work dir.
+        assert (pilot_env / "work" / "cycle-00002"
+                / "validate-ingest").is_dir()
+        pilot.server.close()
+
+    def test_staleness_gauge_exported(self, pilot_env):
+        from photon_tpu import obs
+
+        cfg = make_config(pilot_env)
+        pilot = Pilot(cfg, server_factory=make_server)
+        pilot.run_cycle()
+        snap = obs.REGISTRY.snapshot()["gauges"]
+        assert snap.get("pilot_promotions_total") == 1.0
+        assert snap.get("pilot_staleness_seconds", 0) > 0
+        fams = {f["name"]: f for f in pilot.metrics_families()}
+        stage = fams["pilot_cycle_stage_state"]
+        hot = [s for s in stage["samples"] if s[2] == 1.0]
+        assert hot == [("", {"state": "IDLE"}, 1.0)]
+        assert fams["pilot_staleness_seconds"]["samples"][0][2] > 0
+        pilot.server.close()
+
+
+def _requests_for(server, n: int, seed: int = 0):
+    from photon_tpu.serve.driver import synthetic_requests
+
+    return synthetic_requests(
+        server.programs.tables, server.programs, n, seed=seed
+    )
+
+
+# --------------------------------------------------------------------------
+# chaos: every stage killed / poisoned, pilot resumes
+# --------------------------------------------------------------------------
+
+
+class TestPilotChaos:
+    def test_transient_ingest_fault_is_retried(self, pilot_env):
+        from photon_tpu.resilience import retry_stats
+
+        cfg = make_config(pilot_env)
+        pilot = Pilot(cfg, server_factory=make_server)
+        plan = FaultPlan([dict(point="pilot.ingest", nth=1)], seed=3)
+        with faults.injected(plan):
+            r = pilot.run_cycle()
+        assert "error" not in r
+        assert pilot.state.promotions == 1
+        assert retry_stats()["recovered"] >= 1
+        pilot.server.close()
+
+    def test_poison_train_fails_then_resumes_at_train(self, pilot_env):
+        cfg = make_config(pilot_env)
+        pilot = Pilot(cfg, server_factory=make_server)
+        plan = FaultPlan(
+            [dict(point="pilot.train", nth=1, error="poison")], seed=3)
+        with faults.injected(plan):
+            r = pilot.run_cycle()
+        assert "error" in r and "Poison" in r["error"]
+        assert pilot.state.stage == "TRAIN"  # committed, resumable
+        assert pilot.state.consecutive_failures == 1
+        assert pilot.backoff_s() > 0
+        # Disarmed, the next pass resumes AT TRAIN and completes.
+        r2 = pilot.run_cycle()
+        assert r2["promotion"]["generation"] == 1
+        assert pilot.state.consecutive_failures == 0
+        pilot.server.close()
+
+    def test_crash_mid_promote_resumes_staged_generation(
+        self, pilot_env
+    ):
+        cfg = make_config(pilot_env)
+        pilot = Pilot(cfg, server_factory=make_server)
+        pilot.run_cycle()
+        write_day(pilot_env / "shards", 1)
+        # nth=2: the FIRST pilot.promote check fires inside the staged
+        # npz's atomic-write window; the SECOND is the post-ring-commit
+        # / pre-reload window — exactly between "generation durable"
+        # and "serving switched".
+        plan = FaultPlan(
+            [dict(point="pilot.promote", nth=2, error="crash")], seed=3)
+        with faults.injected(plan):
+            with pytest.raises(InjectedCrash):
+                pilot.run_cycle()
+        assert pilot.ring.live == 1  # serving commit never happened
+        assert pilot.ring.staged == 2  # the candidate is durable
+        state = load_state(cfg.work_dir)
+        assert state.stage == "PROMOTE"
+        pilot.server.close()
+        # "Restart": a fresh pilot against the same work dir serves the
+        # OLD live generation, then finishes the staged promotion.
+        pilot2 = Pilot(cfg, server_factory=make_server)
+        pilot2.server = make_server(pilot2.ring.load(pilot2.ring.live))
+        r = pilot2.run_cycle()
+        assert r["promotion"]["generation"] == 2
+        assert pilot2.ring.live == 2
+        assert pilot2.ring.staged is None
+        assert pilot2.state.promotions == 2
+        pilot2.server.close()
+
+    def test_crash_mid_ring_write_leaves_old_generation(self, pilot_env):
+        cfg = make_config(pilot_env)
+        pilot = Pilot(cfg, server_factory=make_server)
+        pilot.run_cycle()
+        write_day(pilot_env / "shards", 1)
+        # nth=1: the crash lands INSIDE the staged npz's atomic write —
+        # no staged generation may exist afterwards.
+        plan = FaultPlan(
+            [dict(point="pilot.promote", nth=1, error="crash")], seed=3)
+        with faults.injected(plan):
+            with pytest.raises(InjectedCrash):
+                pilot.run_cycle()
+        pilot.server.close()
+        pilot2 = Pilot(cfg, server_factory=make_server)
+        assert pilot2.ring.live == 1
+        assert pilot2.ring.staged is None
+        assert load_state(cfg.work_dir).stage == "PROMOTE"
+        r = pilot2.run_cycle()  # re-stages and completes
+        assert r["promotion"]["generation"] == 2
+        pilot2.server.close()
+
+    def test_consecutive_failures_degrade_to_serve_only(self, pilot_env):
+        cfg = make_config(pilot_env, max_consecutive_failures=2)
+        pilot = Pilot(cfg, server_factory=make_server)
+        pilot.run_cycle()
+        write_day(pilot_env / "shards", 1)
+        plan = FaultPlan([
+            dict(point="pilot.validate", nth=n, error="poison")
+            for n in (1, 2)
+        ], seed=3)
+        with faults.injected(plan):
+            assert "error" in pilot.run_cycle()
+            assert pilot.state.mode != MODE_SERVE_ONLY
+            assert "error" in pilot.run_cycle()
+        assert pilot.state.mode == MODE_SERVE_ONLY
+        # Serve-only: the loop refuses to train but serving survives.
+        r = pilot.run_cycle()
+        assert r["mode"] == MODE_SERVE_ONLY
+        feats, ids = _requests_for(pilot.server, 1)[0]
+        assert isinstance(
+            pilot.server.submit(feats, ids).result(timeout=10.0), float)
+        # Operator re-arms; the wedged cycle completes.
+        pilot.reset_serve_only()
+        r = pilot.run_cycle()
+        assert r["promotion"]["generation"] == 2
+        pilot.server.close()
+
+    def test_slo_burn_rolls_back_to_previous_generation(
+        self, pilot_env, tmp_path
+    ):
+        from photon_tpu.obs import flight
+
+        cfg = make_config(
+            pilot_env,
+            observe=ObservePolicy(
+                window_s=2.0, poll_s=0.05, max_dispatch_errors=0),
+        )
+        pilot = Pilot(cfg, server_factory=make_server)
+        pilot.run_cycle()
+        write_day(pilot_env / "shards", 1)
+
+        # Poison EVERY dispatch from the moment the new generation is
+        # serving: a helper thread waits for OBSERVE, then fires
+        # requests whose dispatch failures are the SLO burn.
+        plan = FaultPlan(
+            [dict(point="serve.dispatch", probability=1.0,
+                  error="poison")],
+            seed=3,
+        )
+
+        def burn():
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                if load_state(cfg.work_dir).stage == "OBSERVE":
+                    break
+                time.sleep(0.02)
+            faults.arm(plan)
+            for feats, ids in _requests_for(pilot.server, 4, seed=9):
+                try:
+                    pilot.server.submit(feats, ids).exception(
+                        timeout=10.0)
+                except Exception:  # noqa: BLE001 — burn traffic only
+                    pass
+
+        t = threading.Thread(target=burn, daemon=True)
+        flight_dir = tmp_path / "flight"
+        flight.install(str(flight_dir), signals=False)
+        try:
+            t.start()
+            r = pilot.run_cycle()
+        finally:
+            t.join(timeout=30.0)
+            faults.disarm()
+            flight.uninstall()
+        assert r["rollback"]["rolled_back"] is True
+        assert r["rollback"]["from"] == 2 and r["rollback"]["to"] == 1
+        assert pilot.ring.live == 1
+        assert pilot.state.rollbacks == 1
+        entry = [e for e in pilot.ring.entries() if e["gen"] == 2][0]
+        assert entry["rolled_back"]
+        assert "dispatch error" in entry["rollback_reason"]
+        assert list(flight_dir.glob("flight-*.json")), \
+            "rollback must dump a post-mortem"
+        # The rolled-back server still serves (breaker re-armed).
+        feats, ids = _requests_for(pilot.server, 1)[0]
+        assert isinstance(
+            pilot.server.submit(feats, ids).result(timeout=10.0), float)
+        pilot.server.close()
+
+
+# --------------------------------------------------------------------------
+# serve-layer swap machinery the pilot promotes through
+# --------------------------------------------------------------------------
+
+
+class TestReloadMachinery:
+    def test_quiesce_drops_nothing(self):
+        server = make_server(_serving_model(1.0, entities=5))
+        reqs = _requests_for(server, 24, seed=1)
+        futures = []
+
+        def producer():
+            for feats, ids in reqs:
+                futures.append(server.submit(feats, ids))
+
+        t = threading.Thread(target=producer, daemon=True)
+        with server.queue.quiesce():
+            t.start()
+            time.sleep(0.15)  # requests pile up against the pause
+        t.join(timeout=10.0)
+        for fut in futures:
+            assert fut.exception(timeout=10.0) is None
+        assert len(futures) == 24
+        server.close()
+
+    def test_quiesce_entered_mid_linger_blocks_the_pop(self):
+        """The linger race: a worker already WAITING for batch-mates
+        when quiesce() begins must re-park instead of popping when the
+        linger expires — dispatching the old ladder against a mid-swap
+        table generation was exactly the torn-promotion bug."""
+        from photon_tpu.serve.queue import MicroBatchQueue
+
+        server = make_server(_serving_model(1.0, entities=5))
+        queue = MicroBatchQueue(
+            server.programs, max_linger_s=0.05, max_batch=4
+        )
+        feats, ids = _requests_for(server, 1)[0]
+        fut = queue.submit(feats, ids)
+        time.sleep(0.01)  # the worker enters its linger wait
+        with queue.quiesce():
+            # Well past the linger: without the post-linger re-check
+            # the worker would pop and dispatch inside the pause.
+            time.sleep(0.3)
+            assert not fut.done(), \
+                "request dispatched inside the quiesce window"
+        assert fut.exception(timeout=10.0) is None
+        queue.close()
+        server.close()
+
+    def test_structure_change_swaps_ladder_under_quiesce(self):
+        server = make_server(_serving_model(1.0, entities=5))
+        out1 = server.reload(_serving_model(2.0, entities=5))
+        assert out1["values_only"] is True
+        assert out1["programs_compiled"] == 0
+        # Entity vocabulary grows: structure change — new tables AND a
+        # new AOT ladder, swapped without dropping the queue.
+        out2 = server.reload(_serving_model(2.0, entities=9))
+        assert out2["values_only"] is False
+        assert out2["programs_compiled"] == len(
+            server.programs.ladder.rungs)
+        feats, ids = _requests_for(server, 1)[0]
+        assert isinstance(
+            server.submit(feats, ids).result(timeout=10.0), float)
+        assert server.health()["table_generation"] == 2
+        server.close()
+
+    def test_serve_cli_reload_model(self, tmp_path):
+        from photon_tpu.cli import serve as cli_serve
+        from photon_tpu.io.model_io import save_checkpoint
+
+        base = _serving_model(1.0, entities=5)
+        refreshed = _serving_model(3.0, entities=5)
+        save_checkpoint(base, str(tmp_path / "base.npz"),
+                        fault_point=None)
+        save_checkpoint(refreshed, str(tmp_path / "v2.npz"),
+                        fault_point=None)
+        out_path = tmp_path / "serve.json"
+        rc = cli_serve.main([
+            "--checkpoint", str(tmp_path / "base.npz"),
+            "--synthetic", "64",
+            "--batch-sizes", "1,8",
+            "--reload-model", str(tmp_path / "v2.npz"),
+            "--no-flight",
+            "--json", str(out_path),
+        ])
+        assert rc == 0
+        out = json.loads(out_path.read_text())
+        assert out["errors"] == 0
+        (reload_info,) = out["reloads"]
+        assert reload_info["values_only"] is True
+        assert reload_info["programs_compiled"] == 0
+        assert reload_info["summary"]["errors"] == 0
+
+
+def _serving_model(scale: float, entities: int):
+    import jax.numpy as jnp
+
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import (
+        Coefficients,
+        GeneralizedLinearModel,
+    )
+
+    rng = np.random.default_rng(11)
+    prng = np.random.default_rng(12)
+    s, du = 2, 4
+    proj = np.sort(
+        np.stack([prng.permutation(du)[:s] for _ in range(entities)]),
+        axis=1,
+    ).astype(np.int64)
+    return GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(means=jnp.asarray(
+                    scale * rng.normal(size=4).astype(np.float32))),
+                TaskType.LOGISTIC_REGRESSION,
+            ),
+            "features",
+        ),
+        "per-user": RandomEffectModel(
+            coefficients=jnp.asarray(
+                scale * rng.normal(size=(entities, s)).astype(np.float32)
+            ),
+            random_effect_type="userId",
+            feature_shard_id="userShard",
+            task=TaskType.LOGISTIC_REGRESSION,
+            proj_all=proj,
+            entity_keys=tuple(str(i) for i in range(entities)),
+        ),
+    })
+
+
+# --------------------------------------------------------------------------
+# the real thing: SIGTERM between ring commit and reload, via the CLI
+# --------------------------------------------------------------------------
+
+
+def _pilot_cli_config(tmp_path) -> str:
+    cfg = {
+        "task": "LOGISTIC_REGRESSION",
+        "coordinates": {
+            "global": {
+                "type": "fixed", "feature_shard": "features",
+                "regularization": {"type": "L2", "weight": 0.01},
+            },
+            "per-user": {
+                "type": "random", "random_effect_type": "userId",
+                "feature_shard": "features",
+                "regularization": {"type": "L2", "weight": 1.0},
+            },
+        },
+        "num_iterations": 1,
+        "evaluators": ["AUC"],
+        "mesh": "off",
+        "stream_dir": str(tmp_path / "shards"),
+        "work_dir": str(tmp_path / "work"),
+        "keep_generations": 3,
+        "promotion": {"min_delta": {"AUC": -1.0}},
+        "observe": {"window_s": 0.0},
+        "serve": {"rungs": [1, 4]},
+    }
+    path = tmp_path / "pilot.json"
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+def _run_pilot_cli(tmp_path, config, *extra, env_extra=None, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PHOTON_TPU_FAULT_PLAN", None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "photon_tpu.cli.pilot",
+         "--config", config, "--poll-interval", "0.2",
+         "--max-cycles", "1", "--flight-dir", str(tmp_path),
+         "--json", str(tmp_path / "out.json"), *extra],
+        cwd=REPO_ROOT, env=env, timeout=timeout,
+        capture_output=True,
+    )
+
+
+class TestKillDuringPromotionSubprocess:
+    def test_sigterm_between_ring_commit_and_reload(self, tmp_path):
+        """The satellite's exact window: a REAL subprocess pilot takes
+        SIGTERM after the new generation's ring commit but before the
+        serving ``reload()`` commit. The committed state must leave the
+        server on the OLD generation and the pilot resumable — and a
+        plain restart must finish the promotion."""
+        write_day(tmp_path / "shards", 0)
+        config = _pilot_cli_config(tmp_path)
+        # Cycle 1 (bootstrap) runs clean so a live generation exists.
+        proc = _run_pilot_cli(tmp_path, config)
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        out = json.loads((tmp_path / "out.json").read_text())
+        assert out["promotions"] == 1 and out["generation_live"] == 1
+
+        # Cycle 2 dies in the promotion window: pilot.promote call #2
+        # is AFTER stage_candidate's ring commit, BEFORE reload. The
+        # `sigterm` fault kind delivers a real signal; the flight
+        # recorder's chained handler dumps, restores the default
+        # disposition, and the process dies AS a SIGTERM death.
+        write_day(tmp_path / "shards", 1)
+        plan = json.dumps({
+            "seed": 7,
+            "faults": [{"point": "pilot.promote", "nth": 2,
+                        "error": "sigterm"}],
+        })
+        proc = _run_pilot_cli(
+            tmp_path, config,
+            env_extra={"PHOTON_TPU_FAULT_PLAN": plan},
+        )
+        assert proc.returncode in (
+            -signal.SIGTERM, 128 + signal.SIGTERM,
+        ), (proc.returncode, proc.stderr.decode()[-2000:])
+
+        # The durable facts the next process reads: generation 2 is
+        # staged (its npz committed), generation 1 is still live (the
+        # serving commit never happened), and the state machine is
+        # parked at PROMOTE.
+        ring = GenerationRing(
+            str(tmp_path / "work" / "generations"), keep=3)
+        assert ring.live == 1
+        assert ring.staged == 2
+        state = load_state(str(tmp_path / "work"))
+        assert state.stage == "PROMOTE"
+        assert state.promotions == 1
+        # The SIGTERM'd process left a flight post-mortem.
+        assert list(tmp_path.glob("flight-*.json"))
+
+        # Plain restart: serves gen 1 first, then finishes the staged
+        # promotion and commits gen 2 live.
+        proc = _run_pilot_cli(tmp_path, config)
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        out = json.loads((tmp_path / "out.json").read_text())
+        assert out["promotions"] == 2
+        assert out["generation_live"] == 2
+        assert out["stage"] == "IDLE"
+        ring = GenerationRing(
+            str(tmp_path / "work" / "generations"), keep=3)
+        assert ring.live == 2 and ring.staged is None
+
+
+# --------------------------------------------------------------------------
+# evaluate_model: the gate's shared ruler
+# --------------------------------------------------------------------------
+
+
+class TestEvaluateModel:
+    def test_matches_fit_recorded_evaluation(self, pilot_env):
+        from photon_tpu.data.stream import StreamingIngest
+
+        data, _ = StreamingIngest(
+            str(pilot_env / "shards"),
+            work_dir=str(pilot_env / "ingest"),
+        ).run()
+        est = make_estimator()
+        result = est.fit(data, validation=data)[0]
+        rescored = est.evaluate_model(result.model, data, data)
+        assert rescored.evaluations["AUC"] == pytest.approx(
+            result.evaluation.evaluations["AUC"], abs=1e-6)

@@ -280,8 +280,9 @@ class TestTransientRetry:
     def test_injected_transients_retried_to_success(
         self, shard_dir, tmp_path, serial_ingest_env
     ):
+        # (retry counters start at zero: the conftest autouse fixture
+        # resets them before every test.)
         _, imap = read_training_examples(shard_dir)
-        reset_retry_stats()
         plan = FaultPlan([
             dict(point="io.shard_read", nth=1),
             dict(point="io.shard_decode", nth=1),
@@ -311,7 +312,6 @@ class TestTransientRetry:
         self, shard_dir, tmp_path, serial_ingest_env
     ):
         _, imap = read_training_examples(shard_dir)
-        reset_retry_stats()
         plan = FaultPlan([
             dict(point="io.shard_read", nth=n) for n in (1, 2, 3)
         ])
@@ -322,7 +322,6 @@ class TestTransientRetry:
                     index_maps={"features": imap},
                 ).run()
         assert retry_stats()["exhausted"] == 1
-        reset_retry_stats()
 
 
 @pytest.fixture()

@@ -41,7 +41,6 @@ from photon_tpu import obs
 from photon_tpu.obs import flight
 from photon_tpu.obs import trace
 from photon_tpu.resilience import FaultPlan, InjectedCrash, faults
-from photon_tpu.resilience.retry import reset_retry_stats
 
 REPO_ROOT = Path(__file__).resolve().parents[1]
 
@@ -410,9 +409,6 @@ class TestRequestTracing:
 
 class TestFlightRecorder:
     def test_dump_payload_sections(self, telemetry, tmp_path):
-        # retry stats are process-global and always-on: earlier suites'
-        # injected transients would leak into the zero assertion below.
-        reset_retry_stats()
         rec = flight.install(str(tmp_path), signals=False)
         try:
             with obs.span("doomed_section"):
