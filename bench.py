@@ -154,6 +154,15 @@ FLOORS = {
     # gauge EXISTS (a dead gauge is the regression there), since a CPU
     # wall clock against a v5e roofline is not a meaningful ratio.
     "logistic_measured_vs_roofline_max": 35.0,
+    # Cost-ledger attribution (obs/ledger.py): the fraction of the
+    # measured steady-state fit wall that lands on NAMED
+    # (coordinate, phase, program) rows — the residual rides as the
+    # explicit `unattributed` row. FLOOR at TPU scale: an attribution
+    # layer that names less than 95% of the wall is not an instrument.
+    # The CPU smoke asserts the block ENGAGED (rows + a non-None
+    # fraction); per-fit host overhead is proportionally larger at
+    # smoke scale, so the 0.95 bar applies to the full bench only.
+    "logistic_attributed_fraction_min": 0.95,
 }
 # Floor checks compare the BEST of this many ingest measurements (first
 # prepare + the warm-cycle prepare + one extra replan): BENCH_r05 logged
@@ -560,7 +569,13 @@ def run_variant(task_name):
     )
 
     # Steady state: aggregate whole fits until the measurement window is
-    # long enough that per-fit dispatch jitter is noise.
+    # long enough that per-fit dispatch jitter is noise. The cost
+    # ledger windows the same loop: every second of it must come back
+    # as a named (coordinate, phase, program) row or the explicit
+    # `unattributed` residual (obs/ledger.py; gated via FLOORS).
+    from photon_tpu.obs import ledger
+
+    ledger_mark = ledger.mark()
     fits = 0
     result = None
     t0 = time.perf_counter()
@@ -571,6 +586,9 @@ def run_variant(task_name):
         if train_seconds_total >= MIN_MEASURE_SECONDS and fits >= 3:
             break
     per_fit = train_seconds_total / fits
+    attribution = ledger.attribution_since(
+        ledger_mark, wall_seconds=train_seconds_total
+    )
 
     # Warm-cache e2e: a COMPLETE second cycle — fresh data objects, fresh
     # estimator, prepare + first fit — in the same process, where the jit
@@ -618,6 +636,7 @@ def run_variant(task_name):
         est, datasets, per_fit, data.num_samples)
     return dict(
         cost_model=cost_model,
+        attribution=attribution,
         ingest_seconds=ingest_seconds,
         compile_seconds=compile_seconds,
         first_fit_seconds=first_fit_seconds,
@@ -711,6 +730,9 @@ def run_serving() -> dict:
         tables, programs, N_SERVE_REQUESTS,
         cold_fraction=SERVE_COLD_FRACTION, seed=7,
     )
+    from photon_tpu.obs import ledger
+
+    ledger_mark = ledger.mark()
     before = compile_event_count()
     with MicroBatchQueue(
         programs, max_linger_s=SERVE_MAX_LINGER_MS / 1e3,
@@ -729,6 +751,10 @@ def run_serving() -> dict:
         health = queue.health()
     compile_events = compile_event_count() - before
     return {
+        # Cost-ledger view of the drive: per-rung dispatch rows
+        # (seconds, dispatch counts, host gaps) — which rung the wall
+        # actually went to, next to the latency percentiles.
+        "serving_attribution": ledger.attribution_since(ledger_mark),
         "serving_requests": summary["requests"],
         "serving_p50_ms": summary["p50_ms"],
         "serving_p90_ms": summary["p90_ms"],
@@ -1153,6 +1179,31 @@ def pilot_regressions(pilot: dict) -> list[str]:
     return out
 
 
+def attribution_regressions(name: str, attribution: dict) -> list[str]:
+    """The cost-ledger acceptance gate (full TPU-scale bench only):
+    >= `logistic_attributed_fraction_min` of the measured steady-state
+    fit wall must carry a (coordinate, phase, program) name, with the
+    residual reported as the explicit `unattributed` row. The CPU
+    smoke gates ENGAGEMENT instead (run_smoke)."""
+    floor_key = f"{name}_attributed_fraction_min"
+    floor = FLOORS.get(floor_key)
+    if floor is None or not isinstance(attribution, dict):
+        return []
+    fraction = attribution.get("attributed_fraction")
+    if fraction is None:
+        return [
+            f"{name} attribution produced no attributed_fraction "
+            "(cost ledger dead)"
+        ]
+    if fraction < floor:
+        return [
+            f"{name}_attributed_fraction {fraction:.3f} < {floor:.2f} "
+            "(the ledger left wall clock unnamed beyond the "
+            "unattributed budget)"
+        ]
+    return []
+
+
 def roofline_regressions(name: str, cost_model: dict) -> list[str]:
     """The ``measured_vs_roofline`` gate (a tracked bench metric since
     round 8, not just a report field). A missing ratio is NOT a
@@ -1512,6 +1563,14 @@ def _variant_fields(name: str, v: dict) -> dict:
         f"{name}_measured_vs_roofline": (
             v["cost_model"].get("measured_vs_roofline")
             if isinstance(v["cost_model"], dict) else None),
+        # Cost-ledger attribution of the steady-state window
+        # (obs/ledger.py): named rows + the explicit unattributed
+        # residual. The fraction is ALSO surfaced top-level — it is a
+        # benchtrend-tracked metric with a FLOORS gate, not just a
+        # report field.
+        f"{name}_attribution": v["attribution"],
+        f"{name}_attributed_fraction": v["attribution"].get(
+            "attributed_fraction"),
     }
 
 
@@ -1583,6 +1642,29 @@ def run_smoke(streaming: bool = False, pilot: bool = False) -> dict:
         regressions.append(
             "cost model produced no measured_vs_roofline "
             f"(roofline gauge dead: {cm.get('error') or cm.get('skipped')!r})")
+    # The cost ledger must ENGAGE on the CI workload (its 0.95
+    # attribution floor is judged at TPU scale only — smoke fits are
+    # milliseconds, so per-fit host overhead is proportionally large):
+    # named rows recorded, a computable fraction, and the explicit
+    # unattributed residual present.
+    attr = lin.get("attribution") or {}
+    named = [
+        r for r in attr.get("rows", ())
+        if r.get("program") != "unattributed"
+    ]
+    if not named:
+        regressions.append(
+            "cost ledger recorded no named attribution rows "
+            "(ledger feed dead)")
+    if attr.get("attributed_fraction") is None:
+        regressions.append(
+            "cost ledger produced no attributed_fraction "
+            "(attribution gauge dead)")
+    if not any(
+        r.get("program") == "unattributed" for r in attr.get("rows", ())
+    ):
+        regressions.append(
+            "cost ledger dropped its explicit unattributed row")
     # Serving smoke: the full online path (tables -> AOT ladder -> queue
     # -> driver) at CI scale; its zero-recompile + error checks join the
     # smoke regression list. Runs BEFORE the telemetry snapshot so the
@@ -1689,8 +1771,15 @@ def main(argv=None):
     # statically (`--semantic`, the `telemetry` contract) — the bench's
     # e2e floors are the runtime half of that guarantee.
     from photon_tpu import obs
+    from photon_tpu.obs import ledger
 
     obs.enable()
+    # The cost ledger rides every bench run next to telemetry: each
+    # scenario windows it (`attribution` blocks) and the logistic
+    # steady-state fraction is a FLOORS-gated, benchtrend-tracked
+    # metric. Zero-overhead is audited (the tier-2 `ledger` contract)
+    # and runtime-gated (cli.profile --overhead-check in CI).
+    ledger.enable()
 
     if args.smoke:
         _apply_smoke()
@@ -1731,6 +1820,8 @@ def main(argv=None):
             f"logistic_compile_seconds {logi['compile_seconds']:.1f} > "
             f"{FLOORS['logistic_compile_seconds_max']:.1f}")
     regressions.extend(roofline_regressions("logistic", logi["cost_model"]))
+    regressions.extend(
+        attribution_regressions("logistic", logi["attribution"]))
     regressions.extend(serving_regressions(serving))
     regressions.extend(streaming_regressions(streaming))
     regressions.extend(pilot_regressions(pilot))

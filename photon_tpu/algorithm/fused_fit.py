@@ -893,6 +893,63 @@ class FusedFit:
         scale = float(total_seconds) / total_w
         return {k: v * scale for k, v in weights.items()}
 
+    def _ledger_record(
+        self, coords, sp, mat_window, t_fit0, rec_seconds, ebs_all
+    ) -> None:
+        """Cost-ledger accounting for one measured fit (obs/ledger.py).
+
+        Registers the generation's two programs with LAZY static-cost
+        thunks (pricing lowers at report time, never here), records the
+        materialize/fit dispatch windows with per-coordinate attribution
+        when the fit window was pure, accounts the slab buffers, and
+        books the residual (operand assembly, AOT wait) as the explicit
+        ``unattributed`` row. Only reached with telemetry on (``sp`` is
+        the synced fit span — the one real measurement) and the ledger
+        armed.
+        """
+        from photon_tpu.analysis import costmodel
+        from photon_tpu.obs import ledger
+
+        ledger.register_program(
+            "materialize", phase="materialize",
+            cost_thunk=lambda: costmodel.program_cost(
+                self.lower_materialize(coords)),
+        )
+        ledger.register_program(
+            "fused_fit", phase="fit",
+            cost_thunk=lambda: costmodel.program_cost(
+                self.lower(coords)),
+        )
+        mat_seconds = 0.0
+        if mat_window is not None:
+            t0, t1 = mat_window
+            mat_seconds = t1 - t0
+            ledger.record_dispatch(
+                "materialize", mat_seconds, phase="materialize",
+                start=t0, end=t1,
+            )
+            ledger.set_resident(
+                "fused_fit/slabs", ledger.tree_nbytes(ebs_all)
+            )
+        fit_seconds = max(sp.t1 - t_fit0, 0.0)
+        parts = None
+        if rec_seconds:
+            # Fold the per-(iteration, coordinate) attribution down to
+            # per-coordinate shares; an impure window (cold fallback,
+            # retried attempt) keeps parts=None and the whole fit
+            # window lands as ONE measured-only row — degradation, not
+            # a fabricated split.
+            parts = {}
+            for (_, cid), s in rec_seconds.items():
+                parts[cid] = parts.get(cid, 0.0) + s
+        ledger.record_dispatch(
+            "fused_fit", fit_seconds, phase="fit",
+            start=t_fit0, end=sp.t1, parts=parts,
+        )
+        ledger.record_unattributed(
+            max(sp.seconds - fit_seconds - mat_seconds, 0.0)
+        )
+
     # ------------------------------------------------------------------
     # abstract lowering (the semantic auditor / cost model entry)
     # ------------------------------------------------------------------
@@ -997,14 +1054,22 @@ class FusedFit:
             # operands. When the estimator provides a share, sibling
             # programs (other static keys of the same generation) reuse
             # the same device slabs.
+            # The materialize window (cost-ledger row when armed): only
+            # a run that actually gathered slabs records one — a cache
+            # hit dispatched nothing.
+            mat_window = None
             if self._mat_shared is not None:
                 ebs_all = self._mat_shared.get("ebs")
                 if ebs_all is None:
+                    t_m0 = time.perf_counter()
                     ebs_all = self._mat_shared["ebs"] = self._run_mat(
                         coords, aot)
+                    mat_window = (t_m0, time.perf_counter())
             else:
                 if self._mat_cache is None:
+                    t_m0 = time.perf_counter()
                     self._mat_cache = self._run_mat(coords, aot)
+                    mat_window = (t_m0, time.perf_counter())
                 ebs_all = self._mat_cache
             # The attribution window opens HERE: operand assembly, the
             # AOT compile wait, and slab materialization above are not
@@ -1143,6 +1208,11 @@ class FusedFit:
                 # consumers never fetch a second time.
                 rec_seconds = self._attribute_seconds(
                     fit_seconds, ops, packed, diag_index)
+        from photon_tpu.obs import ledger
+
+        if ledger.enabled() and sp is not None:
+            self._ledger_record(
+                coords, sp, mat_window, t_fit0, rec_seconds, ebs_all)
         for i, cid in enumerate(self.seq):
             coord = coords[cid]
             kind = self.kinds[cid]

@@ -1104,6 +1104,101 @@ def build_trace() -> ContractTrace:
     )
 
 
+def build_ledger() -> ContractTrace:
+    """The cost ledger's audited zero-overhead guarantee.
+
+    The fused materialize + whole-fit programs are traced with the
+    ledger OFF (base) and then FULLY ARMED — enabled, a program in the
+    census, dispatch/compile/resident records landing through every
+    recording helper between the two traces. The ``ledger_toggle``
+    variant must be byte-identical to the base with ZERO added
+    programs: attribution rows are host dicts under a host lock, the
+    static-cost join is a lazy thunk priced at report time, and a
+    ledger-DISABLED run registers nothing at all (the census stays
+    empty — the profile-smoke CI job asserts that end too).
+    """
+    from photon_tpu import obs
+    from photon_tpu.obs import ledger
+
+    with _serial_ingest_env():
+        est, data = _tiny_glmix()
+        datasets, _ = est.prepare(data)
+        coords = est._build_coordinates(
+            datasets, {}, {}, data.num_samples
+        )
+        fused = est._fused_for(coords, datasets)
+        was_enabled = obs.enabled()
+        was_ledger = ledger.enabled()
+        obs.disable()
+        ledger.disable()
+        try:
+            mat_off = trace_program(
+                "materialize", fused._mat_jit, fused._mat_operands(coords)
+            )
+            traced_off = fused.trace(coords)
+            fit_off = TracedProgram(
+                name="fit",
+                text=str(traced_off.jaxpr),
+                jaxpr=traced_off.jaxpr,
+                lowered=traced_off.lower(),
+            )
+            # Arm the whole layer and keep the accumulators HOT while
+            # the armed trace is taken: census, dispatch rows (with
+            # per-coordinate parts + host-gap), compile ledger, and
+            # the resident account all receive records.
+            obs.enable()
+            ledger.enable()
+            try:
+                ledger.register_program(
+                    "audit/program", phase="audit",
+                    cost={"flops": 1.0, "hbm_bytes": 1.0},
+                )
+                ledger.record_dispatch(
+                    "audit/program", 1e-3, phase="audit",
+                    start=0.0, end=1e-3,
+                    parts={"audit-coord": 1e-3},
+                )
+                ledger.record_unattributed(1e-4)
+                ledger.record_compile("audit/key", 1e-2)
+                ledger.set_resident("audit/table", 128.0)
+                mat_on = trace_program(
+                    "materialize", fused._mat_jit,
+                    fused._mat_operands(coords),
+                )
+                traced_on = fused.trace(coords)
+                fit_on = TracedProgram(
+                    name="fit", text=str(traced_on.jaxpr)
+                )
+            finally:
+                # Audit debris must not leak into a later in-process
+                # consumer's ledger (a bench attribution window, a
+                # pilot cycle report).
+                ledger.reset()
+        finally:
+            obs.TRACER.enabled = was_enabled
+            if was_ledger:
+                ledger.enable()
+            else:
+                ledger.disable()
+    return ContractTrace(
+        programs={"materialize": mat_off, "fit": fit_off},
+        variants={
+            "ledger_toggle": [
+                {
+                    "materialize": mat_on.signature,
+                    "fit": fit_on.signature,
+                }
+            ]
+        },
+        notes=[
+            "ledger armed (census + dispatch rows + compile ledger + "
+            "resident account all fed) traced the same materialize/fit "
+            "jaxprs as the all-off base: attribution is host "
+            "bookkeeping, pricing is lazy at report time",
+        ],
+    )
+
+
 def build_monitor() -> ContractTrace:
     """The live-monitoring layer's audited zero-overhead guarantee.
 
@@ -1768,6 +1863,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_ingest_pipeline": build_ingest_pipeline,
     "build_telemetry": build_telemetry,
     "build_trace": build_trace,
+    "build_ledger": build_ledger,
     "build_monitor": build_monitor,
     "build_pilot": build_pilot,
     "build_serving": build_serving,

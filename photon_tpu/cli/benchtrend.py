@@ -28,7 +28,10 @@ Rules:
 
 Usage:
     python -m photon_tpu.cli.benchtrend [--dir .] [--json PATH]
-    python tools/bench_trend.py            # same tool, script entry
+
+This module is the ONE implementation (the old ``tools/bench_trend.py``
+script shim was deleted): every tracked metric — including the cost
+ledger's ``*_attributed_fraction`` — gates in exactly one place.
 """
 
 from __future__ import annotations
@@ -67,6 +70,15 @@ TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
     # round it happens.
     "pilot_staleness_seconds": ("lower", 1.5, ()),
     "pilot_promotions": ("higher", 1.5, ()),
+    # Cost-ledger attribution (round 12+, photon_tpu.obs.ledger): the
+    # fraction of the measured steady-state fit wall attributed to
+    # named (coordinate, phase, program) rows. Tracked HERE and only
+    # here (tools/bench_trend.py was deleted for exactly this reason):
+    # a ledger that silently starts naming less of the wall regresses
+    # the round it happens. Tight tolerance — the fraction is bounded
+    # by 1.0, so a 1.5x ratchet could never fire.
+    "logistic_attributed_fraction": ("higher", 1.1, ()),
+    "linear_attributed_fraction": ("higher", 1.1, ()),
 }
 
 

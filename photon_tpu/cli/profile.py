@@ -1,0 +1,292 @@
+"""``photon profile``: the cost ledger's top-k report — who burns the time.
+
+Drives a tiny-but-real workload (a fused GLMix fit plus a serve-ladder
+scoring pass) under the cost ledger (``photon_tpu.obs.ledger``) and
+prints the top-k ``(coordinate, phase, program)`` rows ranked by
+wasted-seconds-vs-roofline, each with its blocking reason — dispatch
+gap vs bandwidth vs compute — plus the attribution fraction of the
+measured fit wall. This is the instrument the roofline push steers by:
+``measured_vs_roofline`` says the gap exists; this names it.
+
+Three gates ride along (the profile-smoke CI job's contract):
+
+- **off-census**: the same fit runs FIRST with the ledger disabled and
+  the census must stay EMPTY — a disabled ledger adds zero programs
+  (and, conveniently, the warm-up makes the overhead A/B honest);
+- **engagement**: the top-k table must be non-empty and the fused-fit
+  wall must attribute to named rows (exit 1 otherwise — a dead
+  instrument must not report "clean");
+- **overhead** (``--overhead-check``): warm per-fit wall, ledger off vs
+  on, best-of-N in-process A/B (interleaved arms — the only honest
+  protocol on a noisy shared box); the on/off ratio must stay under
+  ``--overhead-budget`` (default 5%).
+
+Usage:
+    python -m photon_tpu.cli.profile [--top N] [--json PATH]
+        [--rows N] [--entities N] [--iterations N]
+        [--overhead-check] [--overhead-samples N] [--overhead-budget F]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _tiny_workload(rows: int, entities: int, iterations: int):
+    """A miniature single-device GLMix estimator + dataset (one dense
+    fixed effect, one random effect, logistic task) — the smallest
+    structure that exercises the fused materialize/fit programs and a
+    servable model. Mirrors the analysis tier's audit fixture; kept
+    local so the CLI never imports audit machinery."""
+    import numpy as np
+
+    from photon_tpu.data.dataset import DenseFeatures
+    from photon_tpu.data.game_data import make_game_dataset
+    from photon_tpu.data.random_effect import RandomEffectDataConfiguration
+    from photon_tpu.estimators.game_estimator import (
+        FixedEffectCoordinateConfiguration,
+        GameEstimator,
+        RandomEffectCoordinateConfiguration,
+    )
+    from photon_tpu.optim import RegularizationContext, RegularizationType
+    from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
+    from photon_tpu.types import TaskType
+
+    def l2(w):
+        return GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=w,
+        )
+
+    d, du = 6, 4
+    rng = np.random.default_rng(20260804)
+    x = rng.normal(size=(rows, d)).astype(np.float32)
+    x[:, -1] = 1.0
+    xu = rng.normal(size=(rows, du)).astype(np.float32)
+    xu[:, -1] = 1.0
+    users = rng.integers(0, entities, size=rows)
+    y = (rng.uniform(size=rows) < 0.5).astype(np.float32)
+    data = make_game_dataset(
+        y,
+        {"global": DenseFeatures(x), "userShard": DenseFeatures(xu)},
+        id_tags={"userId": users},
+    )
+    est = GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {
+            "global": FixedEffectCoordinateConfiguration(
+                "global", l2(0.01)
+            ),
+            "per-user": RandomEffectCoordinateConfiguration(
+                RandomEffectDataConfiguration("userId", "userShard"),
+                l2(0.5),
+            ),
+        },
+        intercept_indices={"global": d - 1, "userShard": du - 1},
+        num_iterations=iterations,
+        mesh="off",
+    )
+    return est, data
+
+
+def _fit_once(est, data):
+    """One blocking fit (checksum-forced completion — enqueue times
+    are not measurements; same idiom as bench.py)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    r = est.fit(data)[0]
+    for m in r.model.models.values():
+        c = (m.coefficients if hasattr(m, "coefficients")
+             else m.model.coefficients.means)
+        float(np.asarray(jnp.sum(c)))
+    return r
+
+
+def _serve_pass(result, data):
+    """Score the training rows through the REAL serve ladder (tables →
+    AOT rungs → padded dispatch), so serve-phase rows and the compile
+    ledger engage."""
+    from photon_tpu.serve.programs import ScorePrograms, specs_from_dataset
+    from photon_tpu.serve.tables import CoefficientTables
+
+    tables = CoefficientTables.from_game_model(result.model)
+    programs = ScorePrograms(
+        tables, specs=specs_from_dataset(data), compile_now=False
+    )
+    return programs.score_dataset(data)
+
+
+def _overhead_ab(
+    est, data, samples: int, fits_per_sample: int = 3
+) -> dict:
+    """Warm fit wall, ledger off vs on: interleaved arms, best-of-N
+    each (the 2-core CI box is noisy; the BEST of an interleaved series
+    is the only stable estimator of the true floor in-process). Each
+    sample times a small BATCH of fits — a single warm fit is
+    milliseconds, where one scheduler hiccup masquerades as overhead."""
+    from photon_tpu.obs import ledger
+
+    k = max(fits_per_sample, 1)
+    off: list[float] = []
+    on: list[float] = []
+    for _ in range(max(samples, 1)):
+        ledger.disable()
+        t0 = time.perf_counter()
+        for _ in range(k):
+            _fit_once(est, data)
+        off.append(time.perf_counter() - t0)
+        ledger.enable()
+        t0 = time.perf_counter()
+        for _ in range(k):
+            _fit_once(est, data)
+        on.append(time.perf_counter() - t0)
+    best_off, best_on = min(off), min(on)
+    return {
+        "samples": len(off),
+        "fits_per_sample": k,
+        "off_best_seconds": round(best_off, 6),
+        "on_best_seconds": round(best_on, 6),
+        "overhead_fraction": (
+            round(best_on / best_off - 1.0, 4) if best_off > 0 else None
+        ),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon profile", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--top", type=int, default=5,
+                        help="rows in the top-k table")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="also write the full priced report to PATH")
+    parser.add_argument("--rows", type=int, default=512,
+                        help="workload rows")
+    parser.add_argument("--entities", type=int, default=16,
+                        help="random-effect entities")
+    parser.add_argument("--iterations", type=int, default=2,
+                        help="coordinate-descent iterations")
+    parser.add_argument("--fits", type=int, default=3,
+                        help="warm fits inside the measured window")
+    parser.add_argument("--overhead-check", action="store_true",
+                        help="A/B the warm fit ledger-off vs ledger-on "
+                        "and gate the overhead fraction")
+    parser.add_argument("--overhead-samples", type=int, default=25,
+                        help="best-of-N samples per A/B arm (the fits "
+                        "are milliseconds warm; a deep N is what makes "
+                        "the best-of estimator stable on a loaded box)")
+    parser.add_argument("--overhead-budget", type=float, default=0.05,
+                        help="max tolerated on/off overhead fraction")
+    args = parser.parse_args(argv)
+
+    from photon_tpu import obs
+    from photon_tpu.obs import ledger
+
+    failures: list[str] = []
+    obs.enable()
+    ledger.disable()
+    ledger.reset()
+
+    est, data = _tiny_workload(args.rows, args.entities, args.iterations)
+    # Gate 1 — off-census: the ledger-disabled run must register NOTHING
+    # (zero added programs in the dispatch census). Doubles as warm-up:
+    # this pays the compiles, so the A/B and the attribution window
+    # below measure dispatch, not tracing.
+    result = _fit_once(est, data)
+    _serve_pass(result, data)
+    off_snap = ledger.snapshot()
+    if off_snap["programs"] or off_snap["rows"] or off_snap["compiles"]:
+        failures.append(
+            "ledger-disabled run polluted the census: "
+            f"{len(off_snap['programs'])} program(s), "
+            f"{len(off_snap['rows'])} row(s), "
+            f"{len(off_snap['compiles'])} compile key(s)"
+        )
+
+    overhead = None
+    if args.overhead_check:
+        overhead = _overhead_ab(est, data, args.overhead_samples)
+        ledger.reset()  # the A/B's on-arm rows are not the profile
+        if (
+            overhead["overhead_fraction"] is not None
+            and overhead["overhead_fraction"] > args.overhead_budget
+        ):
+            failures.append(
+                f"ledger-on overhead {overhead['overhead_fraction']:.2%}"
+                f" > budget {args.overhead_budget:.2%} "
+                f"(best-of-{overhead['samples']} per arm)"
+            )
+
+    # The profiled window: warm fits + a serve pass, ledger armed.
+    ledger.enable()
+    mark = ledger.mark()
+    t0 = time.perf_counter()
+    for _ in range(max(args.fits, 1)):
+        result = _fit_once(est, data)
+    fit_wall = time.perf_counter() - t0
+    # The fit-window attribution closes BEFORE the serve pass: serve
+    # rows recorded after the fit wall must not count as attributed
+    # fit seconds, or a dead fused-fit feed would hide behind them.
+    fit_attr = ledger.attribution_since(mark, wall_seconds=fit_wall)
+    _serve_pass(result, data)
+    attribution = ledger.attribution_since(mark, wall_seconds=None)
+
+    table = ledger.render_top_k(args.top)
+    rows = ledger.top_k(args.top)
+    print(table)
+    if rows:
+        worst = rows[0]
+        print(
+            f"worst program: {worst['program']} "
+            f"(coordinate={worst['coordinate']}, phase={worst['phase']}) "
+            f"— wasted {worst['wasted_seconds']:.4f}s vs its roofline, "
+            f"blocking: {worst['blocking']}"
+        )
+    print(
+        "fit-window attribution: "
+        f"{fit_attr['attributed_fraction']} of {fit_wall:.4f}s named "
+        f"({fit_attr['unattributed_seconds']:.4f}s unattributed)"
+    )
+    if overhead is not None:
+        print(
+            f"ledger overhead: {overhead['overhead_fraction']} "
+            f"(off {overhead['off_best_seconds']:.4f}s / on "
+            f"{overhead['on_best_seconds']:.4f}s, "
+            f"best-of-{overhead['samples']})"
+        )
+
+    # Gate 2 — engagement: an empty table or a dead attribution means
+    # the instrument is broken, and a broken instrument exiting 0 is
+    # how tracked metrics rot.
+    if not rows:
+        failures.append("top-k table is empty (no dispatches recorded)")
+    if not fit_attr["attributed_fraction"]:
+        failures.append(
+            "fused-fit wall attributed nothing (ledger feed dead)")
+
+    if args.json:
+        doc = {
+            "report": ledger.report(),
+            "attribution": attribution,
+            "fit_window": {
+                "wall_seconds": round(fit_wall, 6),
+                "fits": max(args.fits, 1),
+                **fit_attr,
+            },
+            "overhead": overhead,
+            "failures": failures,
+        }
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2)
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -772,8 +772,14 @@ class GameEstimator:
                 return {
                     "key": key,
                     "statics": art["statics"],
-                    "mat": aot_compile(art["mat_traced"].lower()),
-                    "fit": aot_compile(art["fit_traced"].lower()),
+                    "mat": aot_compile(
+                        art["mat_traced"].lower(),
+                        ledger_key="fused_fit/materialize",
+                    ),
+                    "fit": aot_compile(
+                        art["fit_traced"].lower(),
+                        ledger_key="fused_fit/fit",
+                    ),
                     "mat_text": str(art["mat_traced"].jaxpr),
                     "fit_text": str(art["fit_traced"].jaxpr),
                 }
@@ -830,12 +836,37 @@ class GameEstimator:
     def _score_with_validation(val_ctx, model):
         """Rescore a (re)loaded model against the validation set — same
         model, same scores, so it reproduces a previously recorded
-        metric to float-reassociation tolerance."""
+        metric to float-reassociation tolerance.
+
+        Ledger-armed runs book each coordinate's validation scorer and
+        the metric suite as ``eval``-phase rows (measured host windows —
+        the scorers dispatch asynchronously, so these are enqueue-to-
+        enqueue costs; the suite's evaluate is the sync)."""
+        import time as _time
+
+        from photon_tpu.obs import ledger
+
+        armed = ledger.enabled()
         total = None
         for cid, m in model.items():
+            t0 = _time.perf_counter() if armed else 0.0
             vs = val_ctx.scorers[cid](m)
             total = vs if total is None else total + vs
-        return val_ctx.suite.evaluate(total)
+            if armed:
+                t1 = _time.perf_counter()
+                ledger.record_dispatch(
+                    "eval/score", t1 - t0, phase="eval",
+                    coordinate=cid, start=t0, end=t1,
+                )
+        t0 = _time.perf_counter() if armed else 0.0
+        out = val_ctx.suite.evaluate(total)
+        if armed:
+            t1 = _time.perf_counter()
+            ledger.record_dispatch(
+                "eval/suite", t1 - t0, phase="eval",
+                start=t0, end=t1,
+            )
+        return out
 
     def evaluate_model(
         self,

@@ -35,6 +35,7 @@ import time
 
 from photon_tpu.obs import convergence
 from photon_tpu.obs import flight
+from photon_tpu.obs import ledger
 from photon_tpu.obs import trace
 
 
@@ -123,6 +124,23 @@ PROGRAM_AUDIT = [
         stable_under=("monitor_scrape",),
         hot_loop=True,
     ),
+    # `ledger`: the cost-attribution layer (obs/ledger.py). The fused
+    # materialize + whole-fit programs are traced with the ledger
+    # fully ARMED — enabled, a program registered in the census,
+    # dispatch/compile/resident records landing from the recording
+    # helpers — and must stay byte-identical to the all-off base with
+    # ZERO added programs: rows are host dicts under a host lock,
+    # static cost is priced at report time from a lazy thunk, never
+    # inside (or as) a traced program.
+    dict(
+        name="ledger",
+        entry="obs.ledger cost-attribution census + dispatch rows "
+        "over algorithm.fused_fit (ledger armed vs off)",
+        builder="build_ledger",
+        max_programs=2,
+        stable_under=("ledger_toggle",),
+        hot_loop=True,
+    ),
 ]
 
 
@@ -161,11 +179,13 @@ def enabled() -> bool:
 
 def reset() -> None:
     """Drop all recorded telemetry (spans, metrics, convergence traces,
-    trace events). Does not touch the enabled flag."""
+    trace events, ledger accumulators). Does not touch the enabled
+    flags."""
     TRACER.reset()
     REGISTRY.reset()
     convergence.reset()
     trace.reset()
+    ledger.reset()
 
 
 def set_span_retention(max_spans: int) -> None:
@@ -188,6 +208,7 @@ __all__ = [
     "enable",
     "enabled",
     "flight",
+    "ledger",
     "logged_span",
     "metrics_listener",
     "monitor",

@@ -32,24 +32,33 @@ from __future__ import annotations
 import json
 
 
-def _absorbed_reports() -> dict:
+def _absorbed_reports() -> tuple[dict, dict]:
     """The two pre-existing scalar surfaces the telemetry layer absorbs:
     the ingest pipeline's per-stage report and the persistent compile
-    cache's hit/miss stats (None when unavailable)."""
+    cache's hit/miss stats.
+
+    Returns ``(reports, errors)``: a surface that fails to import or
+    render lands as None in ``reports`` WITH its error recorded in
+    ``errors`` — the exporters surface the degradation visibly (a
+    ``report`` record noting it, a ``degraded_reports`` snapshot key)
+    instead of silently dropping the section."""
     out: dict = {}
+    errors: dict = {}
     try:
         from photon_tpu.data.pipeline import PIPELINE_STATS
 
         out["pipeline"] = PIPELINE_STATS.report()
-    except Exception:  # pragma: no cover — import cycles in odd embeds
+    except Exception as exc:  # noqa: BLE001 — import cycles in odd embeds
         out["pipeline"] = None
+        errors["pipeline"] = repr(exc)
     try:
         from photon_tpu.utils.compile_cache import cache_stats
 
         out["compile_cache"] = cache_stats()
-    except Exception:  # pragma: no cover
+    except Exception as exc:  # noqa: BLE001
         out["compile_cache"] = None
-    return out
+        errors["compile_cache"] = repr(exc)
+    return out, errors
 
 
 def snapshot() -> dict:
@@ -67,7 +76,14 @@ def snapshot() -> dict:
         "metrics": REGISTRY.snapshot(),
         "convergence": convergence.snapshot(),
     }
-    out.update(_absorbed_reports())
+    reports, errors = _absorbed_reports()
+    out.update(reports)
+    if errors:
+        out["degraded_reports"] = errors
+    from photon_tpu.obs import ledger
+
+    if ledger.enabled():
+        out["ledger"] = ledger.snapshot()
     return out
 
 
@@ -107,9 +123,25 @@ def write_jsonl(path: str) -> int:
                     "metric": metric,
                     "values": values,
                 })
-    for name, data in _absorbed_reports().items():
-        if data is not None:
+    reports, errors = _absorbed_reports()
+    for name, data in reports.items():
+        if data is None:
+            # A degraded surface is still a VISIBLE record: the
+            # consumer sees "this export is missing its pipeline /
+            # compile-cache section and why", not a silent hole.
+            lines.append({
+                "type": "report", "name": name,
+                "data": {"degraded": True, "error": errors.get(name)},
+            })
+        else:
             lines.append({"type": "report", "name": name, "data": data})
+    from photon_tpu.obs import ledger
+
+    if ledger.enabled():
+        lines.append({
+            "type": "report", "name": "ledger",
+            "data": ledger.snapshot(),
+        })
     with open(path, "w") as f:
         for line in lines:
             f.write(json.dumps(line) + "\n")

@@ -170,6 +170,16 @@ class FlightRecorder:
             out["faults_fired"] = faults.fired()
         except Exception as exc:  # noqa: BLE001
             out["resilience_error"] = repr(exc)
+        try:
+            from photon_tpu.obs import ledger
+
+            if ledger.enabled():
+                # Raw accumulators only (snapshot never prices a cost
+                # thunk): a dump must not lower programs while the
+                # process is dying.
+                out["ledger"] = ledger.snapshot()
+        except Exception as exc:  # noqa: BLE001
+            out["ledger_error"] = repr(exc)
         return out
 
     # -- hooks -----------------------------------------------------------

@@ -1022,6 +1022,12 @@ class MonitorServer:
         families = registry_families(REGISTRY.snapshot())
         for collector in self._collectors:
             families.extend(collector())
+        # The cost ledger exposes itself on EVERY monitor (train,
+        # serve, pilot) without per-CLI wiring: empty when disabled,
+        # so an unarmed process scrapes exactly what it always did.
+        from photon_tpu.obs import ledger
+
+        families.extend(ledger.metrics_families())
         stats = self.scrape_stats()
         scrape_samples = [
             ("", {"path": path}, float(n))

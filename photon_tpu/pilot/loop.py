@@ -657,6 +657,14 @@ class Pilot:
             self.state.cycle_shards = list(all_shards)
             self.state.new_shards = list(new)
             self.state.landed_at = self._landed_at(new)
+            # Cost-ledger window for this cycle (obs/ledger.py): the
+            # cycle report carries the per-(coordinate, phase, program)
+            # attribution delta — None when the ledger is unarmed. The
+            # mark is process-local scratch, not committed state: a
+            # resumed cycle simply reports no attribution window.
+            from photon_tpu.obs import ledger
+
+            self._ledger_mark = ledger.mark()
             self._commit()
             logger.info(
                 "pilot: cycle %d triggered by %d new shard(s)",
@@ -825,6 +833,19 @@ class Pilot:
         self._prune_cycle_dirs()
         report["stage"] = "IDLE"
         report["mode"] = self.state.mode
+        from photon_tpu.obs import ledger
+
+        mark = getattr(self, "_ledger_mark", None)
+        self._ledger_mark = None
+        if ledger.enabled() and mark is not None:
+            # Where THIS cycle's seconds went, by (coordinate, phase,
+            # program) — the same rows a flight post-mortem carries
+            # cumulatively (obs/flight.py books the full ledger in
+            # every dump), windowed to the cycle here. A RESUMED cycle
+            # (killed after the trigger, restarted in a new process)
+            # has no mark and reports no window — never the cumulative
+            # process ledger masquerading as one cycle's delta.
+            report["attribution"] = ledger.attribution_since(mark)
         return report
 
     def _prune_cycle_dirs(self) -> None:

@@ -322,10 +322,26 @@ class ScorePrograms:
         if compiled is None:
             t0 = time.perf_counter()
             lowered = self._jitted.lower(*self._sds_args(batch))
-            compiled = compile_cache.aot_compile(lowered)
+            compiled = compile_cache.aot_compile(
+                lowered, ledger_key=f"serve/score@{batch}"
+            )
             self._compiled[batch] = compiled
             self.stats["programs_compiled"] += 1
             self.stats["aot_compile_seconds"] += time.perf_counter() - t0
+            from photon_tpu.obs import ledger
+
+            if ledger.enabled():
+                from photon_tpu.analysis import costmodel
+
+                # The cost thunk RE-lowers at report time rather than
+                # closing over `lowered` (holding every rung's Lowered
+                # alive for the server's lifetime costs more than one
+                # off-path re-lower).
+                ledger.register_program(
+                    f"serve/score@{batch}", phase="serve",
+                    cost_thunk=lambda b=batch: costmodel.program_cost(
+                        self._jitted.lower(*self._sds_args(b))),
+                )
         return compiled
 
     def compile_all(self) -> None:
@@ -369,9 +385,25 @@ class ScorePrograms:
         c = tuple(
             np.asarray(codes[nm], dtype=np.int32) for nm in self._re_names
         )
-        out = self._compiled[batch](fe_ws, re_ws, re_projs, f, c)
+        from photon_tpu.obs import ledger
+
+        if ledger.enabled():
+            # dispatch -> host fetch is the rung's measured window (the
+            # asarray pull is the request path's one sync, so the
+            # window is real execution, not an enqueue stamp).
+            t0 = time.perf_counter()
+            out = self._compiled[batch](fe_ws, re_ws, re_projs, f, c)
+            scores = np.asarray(out)
+            t1 = time.perf_counter()
+            ledger.record_dispatch(
+                f"serve/score@{batch}", t1 - t0, phase="serve",
+                start=t0, end=t1,
+            )
+        else:
+            out = self._compiled[batch](fe_ws, re_ws, re_projs, f, c)
+            scores = np.asarray(out)
         self.stats["dispatches"][batch] += 1
-        return np.asarray(out)[:n]
+        return scores[:n]
 
     def pack_requests(
         self, requests: list[tuple[dict, dict]]

@@ -208,7 +208,31 @@ class CoefficientTables:
                 )
             else:
                 raise TypeError(f"unknown sub-model type for {name!r}")
-        return CoefficientTables(fixed=fixed, random=random, task=model.task)
+        tables = CoefficientTables(
+            fixed=fixed, random=random, task=model.task
+        )
+        tables.account_resident()
+        return tables
+
+    def account_resident(self) -> None:
+        """Book every table's device bytes into the cost ledger's HBM
+        account (owner ``table/<coordinate>``; obs/ledger.py) — one
+        flag check when the ledger is disabled. Called at build and
+        after every reload, so the ledger's per-table resident bytes
+        and peak watermark track the serving footprint (including the
+        transient double-residency of an off-path rebuild)."""
+        from photon_tpu.obs import ledger
+
+        if not ledger.enabled():
+            return
+        for n, t in self.fixed.items():
+            ledger.set_resident(
+                f"table/{n}", ledger.tree_nbytes(t.weights)
+            )
+        for n, t in self.random.items():
+            ledger.set_resident(
+                f"table/{n}", ledger.tree_nbytes((t.weights, t.proj))
+            )
 
     def structure_key(self) -> tuple:
         """Everything a score program specializes on: coordinate names,
@@ -288,6 +312,7 @@ class CoefficientTables:
             self.fixed = new.fixed
             self.random = new.random
             self.task = new.task
+            self.account_resident()
             return False
 
         def swap(old, src):
@@ -304,6 +329,7 @@ class CoefficientTables:
             t.weights = swap(t.weights, src.weights)
             t.task = src.task
         self.task = new.task
+        self.account_resident()
         return True
 
     def rebuild_from(
@@ -365,6 +391,10 @@ class CoefficientTables:
                 new_programs.tables = self
             if adopt is not None:
                 adopt(new_programs)
+        # Outside the quiesce window (host metadata only — the swap
+        # pause must stay minimal): re-book the new generation's
+        # footprint.
+        self.account_resident()
         return new_programs
 
 

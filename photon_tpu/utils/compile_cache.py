@@ -72,7 +72,7 @@ _listener_installed = False
 _dir_in_effect: str | None = None
 
 
-def aot_compile(lowered):
+def aot_compile(lowered, *, ledger_key: str | None = None):
     """Compile a ``jax.stages.Lowered`` for the warm-compile stage.
 
     The compile runs through the SAME persistent-cache wiring as any jit
@@ -85,6 +85,11 @@ def aot_compile(lowered):
     flaky compiler RPC on tunneled backends, the injected
     ``compile.aot`` fault — re-runs ``lowered.compile()`` with backoff;
     deterministic compile errors propagate on the first attempt.
+
+    ``ledger_key`` names this compile in the cost ledger's compile-time
+    account (obs/ledger.py) — callers pass their cache key (the serve
+    ladder's rung, the fused generation's AOT label); None books under
+    ``aot`` when the ledger is armed.
     """
     import time
 
@@ -98,6 +103,12 @@ def aot_compile(lowered):
     with _lock:
         _stats["aot_compiles"] += 1
         _stats["aot_compile_seconds"] += seconds
+    try:
+        from photon_tpu.obs import ledger
+
+        ledger.record_compile(ledger_key or "aot", seconds)
+    except Exception:  # pragma: no cover — telemetry must never abort
+        pass
     return compiled
 
 

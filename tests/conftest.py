@@ -53,7 +53,8 @@ def rng():
 
 @pytest.fixture(autouse=True)
 def _clean_retry_stats():
-    """Zero the process-global retry counters before every test.
+    """Zero the process-global retry counters AND the cost-ledger
+    accumulators before every test.
 
     The retry layer's stats dict (``resilience.retry.retry_stats``) is
     process-global by design — production reads it as a health surface —
@@ -62,8 +63,18 @@ def _clean_retry_stats():
     PRs 8/10 hand-reset it from individual tests; this fixture is that
     idiom factored into the harness: every test STARTS from zero, and
     tests that assert on accumulation within themselves are unaffected.
+
+    The ledger (``photon_tpu.obs.ledger``) gets the same treatment —
+    its census/rows/compiles/resident accounts are process-global, and
+    the "a ledger-off run registers ZERO programs" contract would be
+    unfalsifiable if a previous test's armed run left entries behind.
+    The enable flag is restored to the OFF default too (a test that
+    arms the ledger must not silently instrument its successors).
     """
+    from photon_tpu.obs import ledger
     from photon_tpu.resilience.retry import reset_retry_stats
 
     reset_retry_stats()
+    ledger.reset()
+    ledger.disable()
     yield
