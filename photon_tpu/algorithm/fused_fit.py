@@ -1151,6 +1151,20 @@ class FusedFit:
                 obs.REGISTRY.histogram(
                     "fused_fit_device_wait_seconds"
                 ).observe(sp.device_wait_seconds)
+        # Numerics sentinel (obs/health.py): park the SAME convergence
+        # block — an output the fit program already computes — for lazy
+        # non-finite scanning at gate/report time. Reference
+        # bookkeeping only: no sync, no transfer, no program change
+        # (the audited `health` contract), and it works with health
+        # armed alone (telemetry's span sync is not required).
+        if obs.health.enabled():
+            obs.health.sentinel_watch(
+                tuple(
+                    cid for cid in self.seq
+                    if self.kinds[cid] != "locked"
+                ),
+                conv,
+            )
         # Diagnostic shapes, in the exact flattening order of _fit_fn's
         # packing; indices into _PackedDiags per coordinate.
         shapes: list[tuple] = []

@@ -1199,6 +1199,112 @@ def build_ledger() -> ContractTrace:
     )
 
 
+def build_health() -> ContractTrace:
+    """The model/data-health layer's audited zero-dispatch guarantee.
+
+    The fused materialize + whole-fit programs are traced with health
+    OFF (base) and then FULLY ARMED — enabled, a training DataSketch
+    fed and registered, the serve tap folding sampled batches, a
+    numerics sentinel parked AND materialized (the report scan), and a
+    gate decision recorded — between the two traces. The
+    ``health_toggle`` variant must be byte-identical to the base with
+    ZERO added programs: sketches are host numpy under a host lock,
+    the sentinel parks a reference to an array the fit ALREADY outputs
+    (the convergence block), and PSI/ECE/movement scoring happens at
+    report time, never inside (or as) a traced program.
+    """
+    import numpy as np
+
+    from photon_tpu.obs import health
+
+    with _serial_ingest_env():
+        est, data = _tiny_glmix()
+        datasets, _ = est.prepare(data)
+        coords = est._build_coordinates(
+            datasets, {}, {}, data.num_samples
+        )
+        fused = est._fused_for(coords, datasets)
+        was_health = health.enabled()
+        health.disable()
+        try:
+            mat_off = trace_program(
+                "materialize", fused._mat_jit, fused._mat_operands(coords)
+            )
+            traced_off = fused.trace(coords)
+            fit_off = TracedProgram(
+                name="fit",
+                text=str(traced_off.jaxpr),
+                jaxpr=traced_off.jaxpr,
+                lowered=traced_off.lower(),
+            )
+            # Arm the whole layer and keep every surface HOT while the
+            # armed trace is taken: train sketch, serve tap, parked +
+            # scanned sentinel, recorded gate decision.
+            health.enable()
+            try:
+                sketch = health.DataSketch()
+                sketch.update_window(
+                    np.asarray([0.0, 1.0, 1.0]),
+                    np.zeros(3),
+                    np.ones(3),
+                    {"audit": (
+                        np.asarray([[0, 1], [1, 0], [0, 1]]),
+                        np.asarray([[0.5, 1.0], [2.0, 0.0], [1.5, 0.5]]),
+                    )},
+                    {"audit": 4},
+                )
+                health.set_train_sketch(sketch)
+                health.set_serve_sample_every(1)
+                health.observe_serve_batch(
+                    [{"audit": np.zeros(4, dtype=np.float32)}],
+                    np.asarray([0.25]),
+                )
+                health.sentinel_watch(
+                    ("audit-coord",),
+                    np.asarray([[[1.0, np.nan, 0.0, 0.0, 0.0]]]),
+                )
+                report = health.numerics_report()
+                health.record_gate({
+                    "reasons": [], "nonfinite": report["nonfinite_total"],
+                })
+                mat_on = trace_program(
+                    "materialize", fused._mat_jit,
+                    fused._mat_operands(coords),
+                )
+                traced_on = fused.trace(coords)
+                fit_on = TracedProgram(
+                    name="fit", text=str(traced_on.jaxpr)
+                )
+            finally:
+                # Audit debris (the fake sentinel, the sampled batch)
+                # must not leak into a later in-process consumer's
+                # health surfaces (a pilot gate, a bench drift run).
+                health.reset()
+        finally:
+            if was_health:
+                health.enable()
+            else:
+                health.disable()
+    return ContractTrace(
+        programs={"materialize": mat_off, "fit": fit_off},
+        variants={
+            "health_toggle": [
+                {
+                    "materialize": mat_on.signature,
+                    "fit": fit_on.signature,
+                }
+            ]
+        },
+        notes=[
+            "health armed (train sketch + serve tap + parked/scanned "
+            "numerics sentinel + recorded gate) traced the same "
+            "materialize/fit jaxprs as the all-off base: sketching and "
+            "scoring are host bookkeeping, the sentinel reads an "
+            "output the program already computes",
+        ],
+    )
+
+
 def build_monitor() -> ContractTrace:
     """The live-monitoring layer's audited zero-overhead guarantee.
 
@@ -1863,6 +1969,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_ingest_pipeline": build_ingest_pipeline,
     "build_telemetry": build_telemetry,
     "build_trace": build_trace,
+    "build_health": build_health,
     "build_ledger": build_ledger,
     "build_monitor": build_monitor,
     "build_pilot": build_pilot,

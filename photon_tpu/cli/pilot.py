@@ -23,6 +23,12 @@ pilot blocks::
     promotion: {min_delta: {AUC: -0.005}}
     observe: {window_s: 2.0, max_dispatch_errors: 0}
     serve: {rungs: [1, 8, 64], max_linger_ms: 2.0}
+    health:                         # model/data health gates (PILOT.md)
+      max_drift_psi: 0.25           # this cycle vs last promoted cycle
+      max_skew_psi: 0.5             # training data vs sampled traffic
+      max_ece: 0.1                  # candidate calibration (binary)
+      max_coefficient_rel_l2: 5.0   # warm-start lurch ceiling
+      forbid_nonfinite: true        # numerics sentinels refuse
 """
 
 from __future__ import annotations
@@ -149,6 +155,37 @@ def _build_pilot_config(raw: dict):
 
     promo = raw.get("promotion", {})
     observe = raw.get("observe", {})
+    health_cfg = raw.get("health")
+    health_gate = None
+    if health_cfg is not None:
+        import dataclasses as _dc
+
+        from photon_tpu.obs.health import HealthGatePolicy
+
+        _defaults = {
+            f.name: f.default for f in _dc.fields(HealthGatePolicy)
+        }
+
+        def _opt(key):
+            # An ABSENT key keeps the policy's documented default
+            # (max_drift_psi=0.25); only an explicit `null` disables
+            # the individual gate — `health: {forbid_nonfinite: true}`
+            # must not silently drop the drift gate.
+            if key not in health_cfg:
+                return _defaults[key]
+            v = health_cfg[key]
+            return None if v is None else float(v)
+
+        health_gate = HealthGatePolicy(
+            max_drift_psi=_opt("max_drift_psi"),
+            max_skew_psi=_opt("max_skew_psi"),
+            max_ece=_opt("max_ece"),
+            max_coefficient_rel_l2=_opt("max_coefficient_rel_l2"),
+            forbid_nonfinite=bool(
+                health_cfg.get("forbid_nonfinite", True)),
+            min_skew_requests=int(
+                health_cfg.get("min_skew_requests", 64)),
+        )
     ingest = dict(raw.get("ingest", {}))
     if "feature_shards" in ingest:
         ingest["feature_shards"] = {
@@ -186,6 +223,7 @@ def _build_pilot_config(raw: dict):
             raw.get("max_consecutive_failures", 3)),
         pin_vocabulary=bool(raw.get("pin_vocabulary", True)),
         ingest_kwargs=ingest,
+        health=health_gate,
     )
 
 
@@ -329,6 +367,7 @@ def _run(args) -> int:
         "last_promotion": state.last_promotion,
         "last_refusal": state.last_refusal,
         "last_rollback": state.last_rollback,
+        "last_health": state.last_health,
         "generation_live": pilot.ring.live,
         "generations": [
             {k: e[k] for k in ("gen", "cycle", "created_at")}

@@ -114,6 +114,13 @@ def main(argv=None) -> int:
                         help="write the per-request JSONL stream "
                              "(one record per served/expired/shed/"
                              "breaker-failed request) to PATH")
+    parser.add_argument("--health-sketch", default=None, metavar="PATH",
+                        help="arm the model/data-health serve tap "
+                             "(obs/health.py) and write the sampled "
+                             "request/score sketch to PATH at exit — "
+                             "compare against a training run's "
+                             "ingest-sketch.json with `python -m "
+                             "photon_tpu.cli.health`")
     parser.add_argument("--flight-dir", default=".", metavar="DIR",
                         help="crash flight recorder destination: "
                              "flight-<pid>.json is dumped there on "
@@ -163,8 +170,14 @@ def _run(args) -> int:
     # (the cli/train.py convention — an embedding process's obs state is
     # not ours to flip permanently).
     was_enabled = obs.enabled()
+    was_health = obs.health.enabled()
     obs.reset()
     obs.enable()
+    if args.health_sketch:
+        # Arm the model/data-health serve tap for the run: sampled
+        # request/score sketches accumulate for the exit artifact (and
+        # the health_* /metrics families while serving).
+        obs.health.enable()
     # Crash flight recorder (obs/flight.py): SIGINT/SIGTERM are chained
     # here (serve has no handlers of its own), unhandled exceptions and
     # crash-kind injected faults dump via the block below / the faults
@@ -189,6 +202,8 @@ def _run(args) -> int:
                 # ambient recorder — hand it back re-armed.
                 flight.reinstall(prior_rec)
         obs.TRACER.enabled = was_enabled
+        if not was_health:
+            obs.health.disable()
 
 
 def _run_instrumented(args, obs, compile_event_count) -> int:
@@ -394,6 +409,12 @@ def _serve_instrumented(
         obs.write_chrome_trace(args.trace)
     if args.request_log:
         obs.trace.write_request_jsonl(args.request_log)
+    if args.health_sketch:
+        out["health_sketch"] = {
+            "path": args.health_sketch,
+            "requests_sampled": obs.health.save_serve_sketch(
+                args.health_sketch),
+        }
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2)

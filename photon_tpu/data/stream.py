@@ -81,6 +81,7 @@ logger = logging.getLogger(__name__)
 MANIFEST_FILE = "ingest-manifest.json"
 CURSOR_FILE = "ingest-cursor.json"
 VOCAB_FILE = "ingest-vocab.json"
+SKETCH_FILE = "ingest-sketch.json"
 SCHEMA_VERSION = 1
 
 # Program contract (audited by `python -m photon_tpu.analysis
@@ -840,6 +841,9 @@ class StreamingIngest:
     def _cursor_path(self) -> str:
         return os.path.join(self.work_dir, CURSOR_FILE)
 
+    def _sketch_path(self) -> str:
+        return os.path.join(self.work_dir, SKETCH_FILE)
+
     def _spill_path(self, widx: int) -> str:
         return os.path.join(self.work_dir, f"window-{widx:05d}.npz")
 
@@ -945,6 +949,23 @@ class StreamingIngest:
         self.resolved_maps = dict(maps)
         self.manifest_sha256 = manifest_sha
 
+        # Model/data-health sketching (obs/health.py; OFF by default):
+        # when the health layer is armed, every ingested window folds
+        # into one bounded-memory DataSketch — per-column
+        # moment/quantile/missing sketches plus per-shard value/nnz
+        # histograms and per-feature moments — persisted beside the
+        # cursor (SKETCH_FILE) at every cursor commit. Pure host numpy
+        # on the training thread: the audited `streaming-ingest` and
+        # `health` contracts both pin zero traced-program impact.
+        # Resumed windows re-fold from their spills in window order, so
+        # a kill-and-resume ingest reproduces the byte-identical sketch
+        # (pinned by tests/test_health.py).
+        from photon_tpu.obs import health as _health
+
+        sketch = _health.DataSketch() if _health.enabled() else None
+        widths = {s: len(maps[s]) for s in self.feature_shards}
+        self.health_sketch = sketch
+
         cursor = self._load_cursor(manifest_sha) if self.resume else None
         start_window = 0
         rows_ingested = 0
@@ -972,6 +993,11 @@ class StreamingIngest:
             for w in range(start_window):
                 window = self._load_spill(w)
                 self._transfer_window(window, PIPELINE_STATS)
+                if sketch is not None:
+                    sketch.update_window(
+                        window.labels, window.offsets, window.weights,
+                        window.shards, widths,
+                    )
                 windows.append(window)
             logger.info(
                 "streaming ingest: resumed at shard %d/%d (%d window "
@@ -1028,6 +1054,11 @@ class StreamingIngest:
                     f"({budget}): {sorted(self.stats.quarantined())}")
             self._transfer_window(window, PIPELINE_STATS)
             self._spill_window(window)
+            if sketch is not None:
+                sketch.update_window(
+                    window.labels, window.offsets, window.weights,
+                    window.shards, widths,
+                )
             windows.append(window)
             rows_ingested += window.rows
             next_shard = min(
@@ -1036,12 +1067,21 @@ class StreamingIngest:
             self._commit_cursor(
                 manifest_sha, next_shard, todo[i][0] + 1, rows_ingested
             )
+            if sketch is not None:
+                # Beside the cursor, committed at the same shard
+                # boundary — a resumed run that reloads k windows and
+                # re-folds them lands on this exact file again.
+                sketch.save(self._sketch_path())
 
         data = self._assemble(windows, maps, PIPELINE_STATS)
         stats = self._final_stats(
             manifest, rows_ingested, resumed_from,
             time.perf_counter() - t_run,
         )
+        if sketch is not None:
+            sketch.save(self._sketch_path())
+            _health.set_train_sketch(sketch)
+            stats["health_sketch_path"] = self._sketch_path()
         return data, stats
 
     def _drain(self, pending) -> None:

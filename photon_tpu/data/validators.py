@@ -130,10 +130,11 @@ def sanity_check_data(
 
     errors: list[str] = []
 
-    def check(mask: np.ndarray, message: str) -> None:
+    def check(mask: np.ndarray, message: str, slug: str) -> None:
         bad = int((~mask).sum())
         if bad:
             errors.append(f"{message} [{bad} row(s)]")
+            _record_failure(slug, bad)
 
     seen_tables: set[int] = set()
     for shard_id in sorted(data.feature_shards):
@@ -146,18 +147,38 @@ def sanity_check_data(
             _feature_finite_rows(feats, rows),
             "Data contains row(s) with invalid (+/- Inf or NaN) "
             f"feature(s): {shard_id}",
+            f"features:{shard_id}",
         )
     check(
         _finite_mask(offsets),
         "Data contains row(s) with invalid (+/- Inf or NaN) offset(s)",
+        "offsets",
     )
     check(
         np.isfinite(weights) & (weights > _EPSILON),
         "Data contains row(s) with invalid (-, 0, Inf, or NaN) weight(s)",
+        "weights",
     )
     if check_labels:
         label_mask, message = _label_validators(task)
-        check(label_mask(labels), message)
+        check(label_mask(labels), message, "labels")
 
     if errors:
         raise ValueError("Data Validation failed:\n" + "\n".join(errors))
+
+
+def _record_failure(slug: str, bad_rows: int) -> None:
+    """``health_validation_failures_total{check=...}`` registry counter:
+    rejected rows are visible on /metrics (and in the telemetry
+    snapshot) BEFORE the raised ValueError kills an ingest cycle.
+    Registry mutations are not gated on the telemetry flag — the same
+    policy as the streaming-ingest gauges — and a broken telemetry
+    import must never alter validation semantics."""
+    try:
+        from photon_tpu import obs
+
+        obs.REGISTRY.counter(
+            "health_validation_failures_total", check=slug
+        ).inc(bad_rows)
+    except Exception:  # pragma: no cover — validation must still raise
+        pass

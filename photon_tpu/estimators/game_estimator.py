@@ -833,7 +833,7 @@ class GameEstimator:
         return ValidationContext(suite=suite, scorers=scorers)
 
     @staticmethod
-    def _score_with_validation(val_ctx, model):
+    def _score_with_validation(val_ctx, model, score_sink=None):
         """Rescore a (re)loaded model against the validation set — same
         model, same scores, so it reproduces a previously recorded
         metric to float-reassociation tolerance.
@@ -841,8 +841,17 @@ class GameEstimator:
         Ledger-armed runs book each coordinate's validation scorer and
         the metric suite as ``eval``-phase rows (measured host windows —
         the scorers dispatch asynchronously, so these are enqueue-to-
-        enqueue costs; the suite's evaluate is the sync)."""
+        enqueue costs; the suite's evaluate is the sync).
+
+        ``score_sink`` (optional) receives the EVALUATED scores as host
+        numpy — ``(scores + offsets, labels)``, the exact values the
+        suite judged — after the metrics are computed. The health
+        layer's calibration sketch rides this (obs/health.py
+        ``calibration_sink``); the transfer happens once, post-sync,
+        never inside a fit loop."""
         import time as _time
+
+        import numpy as _np
 
         from photon_tpu.obs import ledger
 
@@ -866,6 +875,11 @@ class GameEstimator:
                 "eval/suite", t1 - t0, phase="eval",
                 start=t0, end=t1,
             )
+        if score_sink is not None:
+            score_sink(
+                _np.asarray(total) + _np.asarray(val_ctx.suite.offsets),
+                _np.asarray(val_ctx.suite.labels),
+            )
         return out
 
     def evaluate_model(
@@ -875,6 +889,7 @@ class GameEstimator:
         validation: GameDataset,
         *,
         initial_model: GameModel | None = None,
+        score_sink=None,
     ) -> EvaluationResults:
         """Evaluate an ARBITRARY GameModel (e.g. the currently-serving
         generation) against ``validation`` with this estimator's
@@ -889,7 +904,9 @@ class GameEstimator:
         vocabulary or projector layout differ from the dataset's are
         remapped by (entity key, feature id) first — entities the
         layout lacks score through the fixed effect, photon-ml's
-        left-join semantics.
+        left-join semantics. ``score_sink`` receives the evaluated
+        host scores + labels (see ``_score_with_validation``) — the
+        health layer's calibration feed.
         """
         import numpy as np
 
@@ -921,7 +938,9 @@ class GameEstimator:
                         proj_all=ds.proj_all,
                     ),
                 )
-        return self._score_with_validation(val_ctx, model)
+        return self._score_with_validation(
+            val_ctx, model, score_sink=score_sink
+        )
 
     def _full_config(self, opt_configs):
         return {

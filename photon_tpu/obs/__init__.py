@@ -35,6 +35,7 @@ import time
 
 from photon_tpu.obs import convergence
 from photon_tpu.obs import flight
+from photon_tpu.obs import health
 from photon_tpu.obs import ledger
 from photon_tpu.obs import trace
 
@@ -141,6 +142,23 @@ PROGRAM_AUDIT = [
         stable_under=("ledger_toggle",),
         hot_loop=True,
     ),
+    # `health`: the model/data-health layer (obs/health.py). The fused
+    # materialize + whole-fit programs are traced with health fully
+    # ARMED — enabled, a train sketch registered, the serve tap fed,
+    # numerics sentinels parked — and must stay byte-identical to the
+    # all-off base with ZERO added programs: sketches are host numpy,
+    # the sentinel parks a reference to an array the fit ALREADY
+    # outputs (the convergence block), and every scan/compare happens
+    # at report time, never inside (or as) a traced program.
+    dict(
+        name="health",
+        entry="obs.health sketches + serve tap + numerics sentinels "
+        "over algorithm.fused_fit (health armed vs off)",
+        builder="build_health",
+        max_programs=2,
+        stable_under=("health_toggle",),
+        hot_loop=True,
+    ),
 ]
 
 
@@ -179,13 +197,14 @@ def enabled() -> bool:
 
 def reset() -> None:
     """Drop all recorded telemetry (spans, metrics, convergence traces,
-    trace events, ledger accumulators). Does not touch the enabled
-    flags."""
+    trace events, ledger accumulators, health sketches/sentinels).
+    Does not touch the enabled flags."""
     TRACER.reset()
     REGISTRY.reset()
     convergence.reset()
     trace.reset()
     ledger.reset()
+    health.reset()
 
 
 def set_span_retention(max_spans: int) -> None:
@@ -208,6 +227,7 @@ __all__ = [
     "enable",
     "enabled",
     "flight",
+    "health",
     "ledger",
     "logged_span",
     "metrics_listener",

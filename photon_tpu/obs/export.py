@@ -84,6 +84,13 @@ def snapshot() -> dict:
 
     if ledger.enabled():
         out["ledger"] = ledger.snapshot()
+    from photon_tpu.obs import health
+
+    if health.enabled():
+        # Full view incl. the numerics report — by snapshot time the
+        # fits completed, so materializing parked sentinels here is a
+        # plain device->host copy (the convergence-trace policy).
+        out["health"] = health.snapshot()
     return out
 
 
@@ -141,6 +148,13 @@ def write_jsonl(path: str) -> int:
         lines.append({
             "type": "report", "name": "ledger",
             "data": ledger.snapshot(),
+        })
+    from photon_tpu.obs import health
+
+    if health.enabled():
+        lines.append({
+            "type": "report", "name": "health",
+            "data": health.snapshot(),
         })
     with open(path, "w") as f:
         for line in lines:

@@ -180,6 +180,17 @@ class FlightRecorder:
                 out["ledger"] = ledger.snapshot()
         except Exception as exc:  # noqa: BLE001
             out["ledger_error"] = repr(exc)
+        try:
+            from photon_tpu.obs import health
+
+            if health.enabled():
+                # Counters + last gate decision only (raw_snapshot):
+                # a dump must not fetch parked sentinel device arrays
+                # while the process is dying — same policy as the
+                # ledger's never-price-mid-crash rule.
+                out["health"] = health.raw_snapshot()
+        except Exception as exc:  # noqa: BLE001
+            out["health_error"] = repr(exc)
         return out
 
     # -- hooks -----------------------------------------------------------

@@ -1028,6 +1028,11 @@ class MonitorServer:
         from photon_tpu.obs import ledger
 
         families.extend(ledger.metrics_families())
+        # Same policy for the model/data-health layer (obs/health.py):
+        # health_* families on every monitor, empty when disarmed.
+        from photon_tpu.obs import health
+
+        families.extend(health.metrics_families())
         stats = self.scrape_stats()
         scrape_samples = [
             ("", {"path": path}, float(n))

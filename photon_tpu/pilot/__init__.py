@@ -14,6 +14,7 @@ Run it: ``python -m photon_tpu.cli.pilot --config pilot.yaml``.
 
 from __future__ import annotations
 
+from photon_tpu.obs.health import HealthGatePolicy
 from photon_tpu.pilot.loop import (
     PROGRAM_AUDIT,
     ObservePolicy,
@@ -33,6 +34,7 @@ from photon_tpu.pilot.state import (
 
 __all__ = [
     "GenerationRing",
+    "HealthGatePolicy",
     "MODE_ACTIVE",
     "MODE_SERVE_ONLY",
     "ObservePolicy",

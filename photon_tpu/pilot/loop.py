@@ -174,6 +174,17 @@ class PilotConfig:
     retry: object = None  # resilience.RetryPolicy | None (default policy)
     pin_vocabulary: bool = True
     ingest_kwargs: dict = dataclasses.field(default_factory=dict)
+    # Model/data-health promotion gates (obs/health.py
+    # ``HealthGatePolicy`` | None = off). When set, the pilot ARMS the
+    # health layer: every cycle's ingest is sketched, the VALIDATE
+    # stage scores drift (this cycle vs the last PROMOTED cycle's
+    # committed sketch), train/serve skew (vs the queue's request tap),
+    # candidate calibration, coefficient movement vs the serving
+    # generation, and the fit's numerics sentinels — and any violation
+    # REFUSES the promotion with recorded ``health:*`` reasons through
+    # the same refusal machinery as the metric gate (state file +
+    # flight post-mortem). PILOT.md documents the knobs.
+    health: object = None  # obs.health.HealthGatePolicy | None
 
 
 class Pilot:
@@ -192,6 +203,14 @@ class Pilot:
             keep=config.keep_generations,
         )
         self.state = load_state(config.work_dir) or PilotState()
+        if config.health is not None:
+            # Health gates need the layer armed: ingest sketching, the
+            # serve tap, and the fused fit's numerics sentinels all key
+            # off the one obs.health flag (host-only; the audited
+            # `health` contract pins zero traced-program impact).
+            from photon_tpu.obs import health
+
+            health.enable()
         self._commit()
 
     # -- plumbing ----------------------------------------------------------
@@ -393,6 +412,10 @@ class Pilot:
         )
         if self.config.pin_vocabulary and not had_pin:
             self._save_vocab(ingest)
+        # This cycle's health sketch (None unless the layer is armed):
+        # the VALIDATE stage's drift/skew evidence, and — on promotion
+        # — the next cycle's reference (committed by _promote).
+        self._cycle_sketch = getattr(ingest, "health_sketch", None)
         # The resolved vocabulary (pinned or this cycle's scan) also
         # keys the validation ingest, so a held-out set always indexes
         # features exactly as training did.
@@ -475,28 +498,127 @@ class Pilot:
     def _validate(self, data, candidate, init):
         """Candidate vs serving through ONE evaluation ruler — on the
         held-out set when ``validation_dir`` is configured, else
-        in-sample; returns (candidate_metrics, incumbent_metrics|None,
-        refusal_reasons)."""
+        in-sample — plus the statistical health gates when
+        ``config.health`` is set; returns (candidate_metrics,
+        incumbent_metrics|None, refusal_reasons, health_block|None)."""
         from photon_tpu.evaluation.evaluators import EvaluatorSpec
 
         val = self._validation_data()
         if val is None:
             val = data
         est = self.config.estimator_factory()
+        policy = self.config.health
+        cal = sink = None
+        if policy is not None and policy.max_ece is not None:
+            from photon_tpu.obs import health
+
+            pair = health.calibration_sink(est.task)
+            if pair is not None:
+                cal, sink = pair
         cand = est.evaluate_model(
-            candidate, data, val, initial_model=init
+            candidate, data, val, initial_model=init, score_sink=sink
         )
-        if init is None:
-            return dict(cand.evaluations), None, []
-        inc = est.evaluate_model(init, data, val, initial_model=init)
-        specs = [
-            s if isinstance(s, EvaluatorSpec) else EvaluatorSpec.parse(s)
-            for s in (est.evaluators or ())
-        ] or [cand.primary_evaluator]
-        reasons = self.config.gate.decide(
-            specs, dict(cand.evaluations), dict(inc.evaluations)
+        reasons: list[str] = []
+        inc_m = None
+        if init is not None:
+            inc = est.evaluate_model(
+                init, data, val, initial_model=init
+            )
+            inc_m = dict(inc.evaluations)
+            specs = [
+                s if isinstance(s, EvaluatorSpec)
+                else EvaluatorSpec.parse(s)
+                for s in (est.evaluators or ())
+            ] or [cand.primary_evaluator]
+            reasons = self.config.gate.decide(
+                specs, dict(cand.evaluations), inc_m
+            )
+        health_block = None
+        if policy is not None:
+            h_reasons, health_block = self._health_gate(
+                policy, candidate, init, cal
+            )
+            reasons.extend(h_reasons)
+        return dict(cand.evaluations), inc_m, reasons, health_block
+
+    def _health_sketch_path(self) -> str:
+        """The last PROMOTED cycle's ingest sketch — the temporal-drift
+        reference the next cycle's gate compares against."""
+        return os.path.join(
+            self.config.work_dir, "pilot-health-sketch.json"
         )
-        return dict(cand.evaluations), dict(inc.evaluations), reasons
+
+    def _health_gate(self, policy, candidate, init, cal):
+        """Score every armed health surface and apply the policy.
+
+        Returns ``(health: reasons, block)`` where ``block`` is the
+        recorded evidence (cycle report + ``state.last_health`` + the
+        ``health_*`` gauges) — a refusal without its numbers would be
+        an alert nobody can act on."""
+        from photon_tpu.obs import health
+
+        block: dict = {}
+        drift = None
+        cycle_sketch = getattr(self, "_cycle_sketch", None)
+        ref_path = self._health_sketch_path()
+        if cycle_sketch is not None and os.path.exists(ref_path):
+            try:
+                ref = health.DataSketch.load(ref_path)
+                drift = health.compare(ref, cycle_sketch)
+            except (OSError, ValueError, KeyError) as exc:
+                # A rotted reference must not wedge the control loop:
+                # the drift gate degrades (visibly) to "no reference".
+                block["drift_error"] = repr(exc)
+                logger.warning(
+                    "pilot: health reference sketch unreadable (%s); "
+                    "drift gate skipped this cycle", exc)
+        skew = None
+        skew_requests = 0
+        if policy.max_skew_psi is not None and cycle_sketch is not None:
+            serve_sk = health.serve_sketch(
+                since=getattr(self, "_serve_mark", None)
+            )
+            skew_requests = serve_sk.rows
+            if serve_sk.shards:
+                skew = health.compare(cycle_sketch, serve_sk)
+        ece = cal.ece() if cal is not None else None
+        movement = (
+            health.coefficient_movement(init, candidate)
+            if init is not None else None
+        )
+        nonfinite = health.numerics_report(
+            since_seq=getattr(self, "_sentinel_mark", 0)
+        )
+        scan = health.scan_model(candidate)
+        reasons = policy.evaluate(
+            drift=drift,
+            skew=skew,
+            skew_requests=skew_requests,
+            ece=ece,
+            movement=movement,
+            nonfinite=nonfinite,
+            model_scan=scan,
+        )
+        block.update({
+            "reasons": list(reasons),
+            "drift": None if drift is None else {
+                "max_psi": drift["max_psi"],
+                "max_ks": drift["max_ks"],
+                "max_psi_surface": drift["max_psi_surface"],
+            },
+            "skew": None if skew is None else {
+                "max_psi": skew["max_psi"],
+                "max_psi_surface": skew["max_psi_surface"],
+                "requests_sampled": skew_requests,
+            },
+            "ece": ece,
+            "coefficient_movement": movement,
+            "nonfinite_total": nonfinite["nonfinite_total"],
+            "model_scan": list(scan),
+        })
+        health.record_gate(block)
+        self.state.last_health = dict(block)
+        return reasons, block
 
     def _promote(self, candidate, metrics) -> dict:
         """Two-step staged→live promotion. The ``pilot.promote`` fault
@@ -527,6 +649,15 @@ class Pilot:
                 policy=self._retry_policy(),
             )
         self.ring.commit_live(gen)
+        # Promote the cycle's ingest sketch to THE drift reference:
+        # the next cycle's gate compares against the data the serving
+        # model actually trained on. Committed only after the ring
+        # commit (a refused or crashed promotion leaves the old
+        # reference in place); a PROMOTE resumed in a fresh process
+        # has no in-memory sketch and keeps the previous reference.
+        sketch = getattr(self, "_cycle_sketch", None)
+        if self.config.health is not None and sketch is not None:
+            sketch.save(self._health_sketch_path())
         return {
             "generation": gen,
             "values_only": reload_out.get("values_only"),
@@ -665,6 +796,28 @@ class Pilot:
             from photon_tpu.obs import ledger
 
             self._ledger_mark = ledger.mark()
+            # Numerics-sentinel window for this cycle (obs/health.py):
+            # only fits parked AFTER this mark can refuse THIS cycle's
+            # promotion — a previous cycle's non-finite fit already had
+            # its refusal. Process-local like the ledger mark: a
+            # resumed cycle re-marks at 0 and scans everything parked
+            # since the restart (conservative, never stale).
+            from photon_tpu.obs import health as _health_mod
+
+            self._sentinel_mark = (
+                _health_mod.sentinel_seq()
+                if self.config.health is not None else 0
+            )
+            # Serve-tap window for the skew gate: the comparison wants
+            # THIS cycle's sampled traffic, not the process-cumulative
+            # tap (a month of history dilutes a fresh shift to
+            # invisibility). Process-local like the marks above; a
+            # resumed cycle has no mark and conservatively compares
+            # the full tap.
+            self._serve_mark = (
+                _health_mod.serve_mark()
+                if self.config.health is not None else None
+            )
             self._commit()
             logger.info(
                 "pilot: cycle %d triggered by %d new shard(s)",
@@ -730,12 +883,14 @@ class Pilot:
                 init = self._init_model()
 
         if stage == "VALIDATE":
-            cand_m, inc_m, reasons = self._stage_run(
+            cand_m, inc_m, reasons, health_block = self._stage_run(
                 "VALIDATE", "pilot.validate",
                 lambda: self._validate(data, candidate, init),
             )
             report["candidate_metrics"] = cand_m
             report["serving_metrics"] = inc_m
+            if health_block is not None:
+                report["health"] = health_block
             if reasons:
                 return self._refuse(report, reasons)
             self.state.stage = stage = "PROMOTE"
@@ -931,12 +1086,20 @@ class Pilot:
 
     def metrics_families(self) -> list[dict]:
         """/metrics collector (register with ``MonitorServer``): the
-        control-loop counters, the one-hot stage state-set, staleness,
-        and the live/staged generation — OBSERVABILITY.md pilot rows."""
+        labeled control-loop outcome counters and the one-hot stage
+        state-set — the families the flat registry gauges CANNOT
+        express. The plain gauges (``pilot_staleness_seconds``,
+        ``pilot_serve_only``, ``pilot_consecutive_failures``,
+        ``pilot_generation_live``, the ``*_total`` counters) already
+        reach every /metrics render through ``_export_gauges`` →
+        the registry collector; re-emitting them here made the two
+        sources collide on the family name and the whole scrape 500
+        ("duplicate metric family" — caught by the health drive's
+        live-scrape check). OBSERVABILITY.md pilot rows."""
         from photon_tpu.obs import monitor
 
         s = self.state
-        fams = [
+        return [
             monitor.family(
                 "pilot_cycle_events_total", "counter",
                 "control-loop outcomes by kind",
@@ -953,27 +1116,4 @@ class Pilot:
                 "pilot_cycle_stage_state", STAGES, s.stage,
                 "one-hot pilot state-machine stage",
             ),
-            monitor.family(
-                "pilot_serve_only", "gauge",
-                "1 when the trainer degraded to serve-only mode",
-                [("", {}, 1.0 if s.mode == MODE_SERVE_ONLY else 0.0)],
-            ),
-            monitor.family(
-                "pilot_consecutive_failures", "gauge",
-                "current failed-cycle streak (backoff driver)",
-                [("", {}, float(s.consecutive_failures))],
-            ),
         ]
-        if s.staleness_seconds is not None:
-            fams.append(monitor.family(
-                "pilot_staleness_seconds", "gauge",
-                "shard-landed -> model-serving seconds, last promotion",
-                [("", {}, float(s.staleness_seconds))],
-            ))
-        if self.ring.live is not None:
-            fams.append(monitor.family(
-                "pilot_generation_live", "gauge",
-                "ring generation currently serving",
-                [("", {}, float(self.ring.live))],
-            ))
-        return fams

@@ -73,6 +73,10 @@ class PilotState:
     last_refusal: dict | None = None
     last_promotion: dict | None = None
     last_rollback: dict | None = None
+    # The most recent health-gate decision (obs/health.py): reasons +
+    # measured drift/skew/ECE/movement numbers. None until a
+    # health-armed cycle reaches VALIDATE.
+    last_health: dict | None = None
     staleness_seconds: float | None = None
     updated_at: float = 0.0
     schema_version: int = SCHEMA_VERSION

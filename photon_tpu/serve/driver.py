@@ -342,4 +342,9 @@ def drive(
         # milliseconds over the ring's records — warmup included; the
         # full per-request stream is obs.trace.write_request_jsonl).
         out["request_trace"] = obs.trace.request_summary()
+    if obs.health.enabled():
+        # The serve-side health tap's view of this drive: sampled
+        # batch/request counts + the score-distribution and request-
+        # feature sketch summaries (obs/health.py serve tap).
+        out["health_tap"] = obs.health.serve_snapshot()
     return out

@@ -1094,6 +1094,38 @@ class MicroBatchQueue:
             if self.slo_tracker is not None:
                 self.slo_tracker.observe_errors(len(batch) + len(drained))
             return
+        # Model/data-health tap (obs/health.py; off by default): fold a
+        # bounded sample of batches — raw request features + served
+        # scores — into the serve-side sketch, the train/serve-skew and
+        # score-distribution evidence the pilot's health gate compares
+        # against the ingest sketch. Outside the queue lock (the tap
+        # has its own leaf lock; obs-health CONCURRENCY_AUDIT), host
+        # numpy only — the audited `health` contract pins zero impact
+        # on the traced score programs.
+        from photon_tpu.obs import health as _health
+
+        if _health.enabled():
+            try:
+                _health.observe_serve_batch(
+                    [r.features for r in batch], np.asarray(scores),
+                    # Spec widths size the sparse per-feature moments
+                    # to the SERVING feature space (vocabulary width),
+                    # so the serve-side sketch aligns with the training
+                    # sketch's moments instead of being pinned by the
+                    # first sampled batch's max index.
+                    widths={
+                        s: self.programs.specs[s].d
+                        for s in self.programs.shard_order
+                    },
+                )
+            except Exception:  # noqa: BLE001 — telemetry must never
+                # alter serving semantics: this runs on the ONE worker
+                # thread with the batch already scored but its futures
+                # not yet resolved; a raising tap (one malformed
+                # request's feature dict) would strand the waiters AND
+                # kill the worker. Same policy as validators'
+                # _record_failure and the pilot's gauge export.
+                logger.exception("serve health tap failed; continuing")
         cold = sum(cold_by_coord.values())
         with self._cond:
             self._consecutive_failures = 0

@@ -70,11 +70,18 @@ def _clean_retry_stats():
     unfalsifiable if a previous test's armed run left entries behind.
     The enable flag is restored to the OFF default too (a test that
     arms the ledger must not silently instrument its successors).
+
+    The health layer (``photon_tpu.obs.health``) follows the same
+    policy: serve-tap sketches, parked numerics sentinels, and the
+    enable flag are process-global, and a prior test's armed pilot run
+    must not leak a sketch (or the armed flag) into its successors.
     """
-    from photon_tpu.obs import ledger
+    from photon_tpu.obs import health, ledger
     from photon_tpu.resilience.retry import reset_retry_stats
 
     reset_retry_stats()
     ledger.reset()
     ledger.disable()
+    health.reset()
+    health.disable()
     yield

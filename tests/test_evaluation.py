@@ -220,3 +220,33 @@ def test_threshold_spec_parse_and_suite(rng):
     np.testing.assert_allclose(
         res.evaluations["PRECISION=0.5"],
         skm.precision_score(y, pred, zero_division=0), rtol=1e-6)
+
+
+def test_per_group_single_class_auc_is_nan_and_counted(rng):
+    """Pin the documented convention: ``evaluate_per_group`` returns
+    NaN for groups the metric is undefined on (single-class AUC), and
+    the health layer's coverage helper COUNTS those groups instead of
+    silently averaging over them (obs/health.py
+    ``count_undefined_groups``)."""
+    # Three groups: 0 is mixed-class (AUC defined), 1 is all-positive,
+    # 2 is all-negative (both undefined).
+    y = np.asarray([0.0, 1.0, 0.0, 1.0, 1.0, 1.0, 0.0, 0.0])
+    qids = np.asarray([0, 0, 0, 0, 1, 1, 2, 2])
+    scores = rng.normal(size=y.shape[0])
+    codes, num_groups, _ = encode_group_ids(qids)
+    suite = make_suite(
+        ["AUC:queryId"], y,
+        group_ids={"queryId": (codes, num_groups)},
+    )
+    per_group = suite.evaluate_per_group(jnp.asarray(scores))
+    vals = per_group["AUC:queryId"]
+    assert vals.shape == (3,)
+    assert np.isfinite(vals[0])
+    assert np.isnan(vals[1]) and np.isnan(vals[2])
+
+    from photon_tpu.obs.health import count_undefined_groups
+
+    cov = count_undefined_groups(per_group)["AUC:queryId"]
+    assert cov["groups"] == 3
+    assert cov["undefined_groups"] == 2
+    assert cov["mean_defined"] == pytest.approx(float(vals[0]))

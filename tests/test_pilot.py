@@ -373,7 +373,28 @@ class TestPilotCycle:
         stage = fams["pilot_cycle_stage_state"]
         hot = [s for s in stage["samples"] if s[2] == 1.0]
         assert hot == [("", {"state": "IDLE"}, 1.0)]
-        assert fams["pilot_staleness_seconds"]["samples"][0][2] > 0
+        events = {
+            s[1]["kind"]: s[2]
+            for s in fams["pilot_cycle_events_total"]["samples"]
+        }
+        assert events["promotion"] == 1.0
+        # The collector must NOT duplicate the registry's plain pilot
+        # gauges — a duplicate family name 500s the whole /metrics
+        # render when both sources scrape together (the cli.pilot
+        # --monitor-port wiring). Staleness reaches /metrics through
+        # the registry collector instead (asserted above).
+        assert "pilot_staleness_seconds" not in fams
+        from photon_tpu.obs.monitor import (
+            MonitorServer,
+            validate_exposition,
+        )
+
+        text = MonitorServer(
+            0, collectors=[pilot.metrics_families]
+        ).render()
+        validate_exposition(text)
+        assert "pilot_staleness_seconds" in text  # via the registry
+        assert "pilot_cycle_stage_state" in text  # via the collector
         pilot.server.close()
 
 

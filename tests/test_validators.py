@@ -146,3 +146,66 @@ class TestCLIValidation:
         cfg_path.write_text(json.dumps(cfg))
         with pytest.raises(ValueError, match="Data Validation failed"):
             main(["--config", str(cfg_path)])
+
+
+class TestValidationTelemetry:
+    """Rejected rows are VISIBLE before the raise kills an ingest cycle:
+    every failed check increments
+    ``health_validation_failures_total{check=...}`` in the metrics
+    registry (→ /metrics via the monitor) with the failed ROW count."""
+
+    def _counters(self):
+        from photon_tpu import obs
+
+        return {
+            k: v
+            for k, v in obs.REGISTRY.snapshot()["counters"].items()
+            if k.startswith("health_validation_failures_total")
+        }
+
+    def test_each_check_records_its_series(self):
+        from photon_tpu import obs
+
+        obs.REGISTRY.reset()
+        x = np.ones((4, 2))
+        x[1, 0] = np.inf
+        d = _data(
+            [0.0, 1.0, 2.0, np.nan],
+            x=x,
+            offsets=[0.0, np.inf, 0.0, 0.0],
+            weights=[1.0, 1.0, 0.0, -1.0],
+        )
+        with pytest.raises(ValueError):
+            sanity_check_data(d, TaskType.LOGISTIC_REGRESSION, "FULL")
+        got = self._counters()
+        assert got[
+            "health_validation_failures_total{check=features:features}"
+        ] == 1.0
+        assert got[
+            "health_validation_failures_total{check=offsets}"] == 1.0
+        assert got[
+            "health_validation_failures_total{check=weights}"] == 2.0
+        # logistic labels: 2.0 and NaN are both non-binary.
+        assert got[
+            "health_validation_failures_total{check=labels}"] == 2.0
+
+    def test_clean_run_records_nothing(self, rng):
+        from photon_tpu import obs
+
+        obs.REGISTRY.reset()
+        sanity_check_data(
+            _data(np.abs(rng.normal(size=10))),
+            TaskType.LINEAR_REGRESSION, "FULL")
+        assert self._counters() == {}
+
+    def test_counters_survive_to_exposition(self):
+        from photon_tpu import obs
+        from photon_tpu.obs.monitor import MonitorServer
+
+        obs.REGISTRY.reset()
+        with pytest.raises(ValueError):
+            sanity_check_data(
+                _data([np.nan, 1.0]), TaskType.LINEAR_REGRESSION,
+                "FULL")
+        text = MonitorServer(0).render()
+        assert "health_validation_failures_total" in text
