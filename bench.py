@@ -132,6 +132,17 @@ N_MOVIES = 20_000
 CD_ITERATIONS = 4
 MIN_MEASURE_SECONDS = 2.0
 
+# Roofline-push knobs (ROADMAP item 2; PERFORMANCE.md). The training
+# variants run the MIXED-PRECISION fused path by default — bf16 slab +
+# score storage with f32 accumulators (numerical parity pinned per
+# family by tests/test_precision.py) — and merge bucket tails so warm
+# refits dispatch fewer, fatter programs. PHOTON_BENCH_PRECISION=float32
+# restores the historical f32 measurement for A/B.
+BENCH_PRECISION = os.environ.get("PHOTON_BENCH_PRECISION", "bfloat16")
+BENCH_MIN_BUCKET_ENTITIES = int(
+    os.environ.get("PHOTON_BENCH_MIN_BUCKET_ENTITIES", "128")
+)
+
 # Per-round wall-clock floors (regression gate): RATCHETED to ~1.5x off
 # the best value achieved in rounds 1-5 (round-5 measurements: 13.7M
 # train rows/s with the fused Newton kernel + gather scoring, 1.5-1.7M
@@ -377,13 +388,15 @@ def build_estimator(task_name="linear"):
             "global": FixedEffectCoordinateConfiguration("global", l2(1e-3)),
             "per-user": RandomEffectCoordinateConfiguration(
                 RandomEffectDataConfiguration(
-                    "userId", "userShard", active_data_upper_bound=512
+                    "userId", "userShard", active_data_upper_bound=512,
+                    min_bucket_entities=BENCH_MIN_BUCKET_ENTITIES,
                 ),
                 l2(1.0),
             ),
             "per-movie": RandomEffectCoordinateConfiguration(
                 RandomEffectDataConfiguration(
-                    "movieId", "movieShard", active_data_upper_bound=2048
+                    "movieId", "movieShard", active_data_upper_bound=2048,
+                    min_bucket_entities=BENCH_MIN_BUCKET_ENTITIES,
                 ),
                 l2(1.0),
             ),
@@ -394,6 +407,7 @@ def build_estimator(task_name="linear"):
             "movieShard": N_MOVIE_FEATURES,
         },
         num_iterations=CD_ITERATIONS,
+        precision=BENCH_PRECISION,
     )
 
 
@@ -677,7 +691,7 @@ def run_variant(task_name):
     )
 
 
-def build_serving_model():
+def build_serving_model(seed: int = 20260803):
     """A GameModel shaped like the training workload's trained output.
 
     Serving latency depends on table SHAPES, not on how the weights were
@@ -697,7 +711,7 @@ def build_serving_model():
     from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
     from photon_tpu.types import TaskType
 
-    rng = np.random.default_rng(20260803)
+    rng = np.random.default_rng(seed)
     du, dm = N_USER_FEATURES + 1, N_MOVIE_FEATURES + 1
 
     def re_model(re_type, shard, e, s):
@@ -746,7 +760,12 @@ def run_serving() -> dict:
     from photon_tpu.utils import compile_event_count
 
     model = build_serving_model()
-    tables = CoefficientTables.from_game_model(model)
+    # Serving rides the SAME precision policy as training: bf16 tables
+    # halve the resident footprint and the per-request gather width
+    # (PERFORMANCE.md; f32 accumulators in the score kernels).
+    tables = CoefficientTables.from_game_model(
+        model, precision=BENCH_PRECISION
+    )
     t0 = time.perf_counter()
     programs = ScorePrograms(tables, ladder=ShapeLadder(SERVE_RUNGS))
     ladder_seconds = time.perf_counter() - t0
@@ -772,9 +791,26 @@ def run_serving() -> dict:
         ),
     ) as queue:
         summary = drive(queue, requests)
+        # Values-only hot reload UNDER THE SAME QUEUE, then the same
+        # request replay: the serving half of the roofline push must
+        # survive a model refresh with zero compile events, and the
+        # p99 delta across the reload rides the output so a reload
+        # that silently degrades the tail is visible in the JSON
+        # comparison (benchtrend tracks serving_p99_ms itself).
+        reload_before = compile_event_count()
+        reload_info = queue.reload_model(build_serving_model(seed=7042))
+        summary_reload = drive(queue, requests)
+        reload_events = compile_event_count() - reload_before
         health = queue.health()
     compile_events = compile_event_count() - before
     return {
+        "serving_reload_values_only": bool(
+            reload_info.get("values_only")),
+        "serving_reload_compile_events": reload_events,
+        "serving_p99_ms_after_reload": summary_reload["p99_ms"],
+        "serving_reload_p99_delta_ms": round(
+            summary_reload["p99_ms"] - summary["p99_ms"], 3),
+        "serving_reload_errors": summary_reload["errors"],
         # Cost-ledger view of the drive: per-rung dispatch rows
         # (seconds, dispatch counts, host gaps) — which rung the wall
         # actually went to, next to the latency percentiles.
@@ -808,6 +844,59 @@ def run_serving() -> dict:
         # bench run every shed/deadline/retry/breaker counter must be
         # zero — gated in serving_regressions.
         "serving_health": health,
+    }
+
+
+def run_kernel_micro() -> dict:
+    """Standalone segment-reduce dispatch at the scoring shape: the
+    kernel's ACHIEVED bytes/s next to its analytic traffic (the
+    benchtrend-tracked ``segment_reduce_bytes_per_sec`` gauge — a
+    ratchet the round it first reports). Skipped where the kernel does
+    not serve this backend: interpret mode would measure the Pallas
+    interpreter, not HBM, and a fallback measurement would masquerade
+    as kernel throughput."""
+    from photon_tpu.ops import segment_reduce as sr
+
+    m = N_ROWS
+    if sr.interpret_required() or not sr.kernel_supported(
+        m, N_ROWS, np.float32
+    ):
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(20260804)
+    # Sorted ids with an EXACT multiplicity bound of 2 (the kernel's
+    # coverage contract is static).
+    ids = jnp.asarray(
+        np.repeat(np.arange(N_ROWS // 2, dtype=np.int32), 2)[:m]
+    )
+    vals = jnp.asarray(rng.normal(size=m).astype(np.float32))
+    out = sr.sorted_segment_sum(
+        vals, ids, N_ROWS, multiplicity=2,
+        site="segment_reduce/micro",
+    )
+    jax.block_until_ready(out)
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = sr.sorted_segment_sum(
+            vals, ids, N_ROWS, multiplicity=2,
+            site="segment_reduce/micro",
+        )
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    info = sr.traced_sites().get("segment_reduce/micro") or {}
+    bytes_per_call = (info.get("cost") or {}).get("hbm_bytes", 0.0)
+    return {
+        "segment_reduce_elements": m,
+        "segment_reduce_bytes_per_call": bytes_per_call,
+        "segment_reduce_bytes_per_sec": round(
+            bytes_per_call * reps / dt, 1) if dt else None,
+        "segment_reduce_fraction_of_hbm_peak": (
+            round(bytes_per_call * reps / dt / PEAK_HBM_BYTES, 6)
+            if dt else None
+        ),
     }
 
 
@@ -1431,6 +1520,22 @@ def serving_regressions(serving: dict) -> list[str]:
     if serving.get("serving_errors", 0) != 0:
         out.append(
             f"{serving['serving_errors']} serving request(s) errored")
+    # The hot-reload half of the zero-recompile contract: the refreshed
+    # model must swap values-only (structure unchanged by construction)
+    # with zero compile-cache events, and the replay must stay clean.
+    if serving.get("serving_reload_values_only") is False:
+        out.append(
+            "serving reload was NOT values-only (structure drift on an "
+            "identical-shape model)")
+    if serving.get("serving_reload_compile_events", 0) != 0:
+        out.append(
+            f"serving reload triggered "
+            f"{serving['serving_reload_compile_events']} compile-cache "
+            "events (zero-recompile reload contract)")
+    if serving.get("serving_reload_errors", 0) != 0:
+        out.append(
+            f"{serving['serving_reload_errors']} serving request(s) "
+            "errored after the hot reload")
     health = serving.get("serving_health") or {}
     for key in ("shed", "deadline_expired", "dispatch_retries",
                 "breaker_trips", "dispatch_errors"):
@@ -1695,6 +1800,7 @@ def run_wide_d():
 
 def _variant_fields(name: str, v: dict) -> dict:
     return {
+        f"{name}_precision": BENCH_PRECISION,
         f"{name}_rows_per_sec": round(v["rows_per_sec"], 1),
         f"{name}_train_seconds": round(v["train_seconds"], 4),
         f"{name}_measured_fits": v["measured_fits"],
@@ -1994,6 +2100,7 @@ def main(argv=None):
     streaming = run_streaming()
     pilot = run_pilot()
     drift = run_drift()
+    kernel_micro = run_kernel_micro()
     sklearn_anchor = run_sklearn_baseline(logi["train_seconds"])
     yahoo = run_yahoo_music()
     a9a = run_a1a_logistic()
@@ -2044,6 +2151,7 @@ def main(argv=None):
     out.update(streaming)
     out.update(pilot)
     out.update(drift)
+    out.update(kernel_micro)
     out.update(sklearn_anchor)
     out.update(yahoo)
     out.update(a9a)

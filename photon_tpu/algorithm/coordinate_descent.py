@@ -34,10 +34,27 @@ Array = jax.Array
 logger = logging.getLogger(__name__)
 
 
-@jax.jit
-def _sub_add(total, old, new):
+def _sub_add_impl(total, old, new):
     """summedScores - oldScores + previousScores as one fused program."""
     return total - old + new
+
+
+# The residual-total CARRY is donated: after `total = _sub_add(total,
+# old, new)` the previous total buffer is dead, so XLA reuses its HBM
+# for the result instead of round-tripping a fresh [n] allocation per
+# coordinate update (the unfused CD sweep's working-set donation;
+# PERFORMANCE.md donation map). The plain twin serves the one aliased
+# case — a single-coordinate descent where the carry IS the stored
+# score (donating a buffer that is also another operand is an XLA
+# runtime error).
+_sub_add_donating = jax.jit(_sub_add_impl, donate_argnums=(0,))
+_sub_add_plain = jax.jit(_sub_add_impl)
+
+
+def _sub_add(total, old, new):
+    if total is old or total is new:
+        return _sub_add_plain(total, old, new)
+    return _sub_add_donating(total, old, new)
 
 
 @jax.jit

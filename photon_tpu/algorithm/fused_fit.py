@@ -72,15 +72,17 @@ logger = logging.getLogger(__name__)
 # machinery in analysis/program.py): one fused-fit generation is at most
 # THREE distinct compiled programs — the slab materialization, the cold
 # whole-fit program, and its warm-start twin (has_init is static). A λ-grid
-# config sweep must re-enter those executables; an optimizer swap or an
-# iteration-count change is a declared recompile (new statics by design).
+# config sweep must re-enter those executables; an optimizer swap, an
+# iteration-count change, or a precision switch (ops/precision.py mixed
+# bf16 vs the default f32) is a declared recompile (new statics/dtypes
+# by design).
 PROGRAM_AUDIT = dict(
     name="fused-fit",
     entry="algorithm.fused_fit.FusedFit (_mat_fn + _fit_fn)",
     builder="build_fused_fit",
     max_programs=3,
     stable_under=("lambda_grid",),
-    recompiles_on=("optimizer_swap", "iteration_count"),
+    recompiles_on=("optimizer_swap", "iteration_count", "precision"),
     hot_loop=True,
 )
 
@@ -229,13 +231,20 @@ def _re_statics(coord: RandomEffectCoordinate) -> dict:
 
 
 def fused_static_key(coords: dict, seq: list[str], num_iterations: int,
-                     locked: set[str]) -> tuple:
+                     locked: set[str],
+                     precision: str = "float32") -> tuple:
     """Hashable descriptor of everything baked into the fused trace.
 
     Initial models are NOT part of the key: warm-start tables are always
     operands (zeros when absent), so their presence never changes the
-    traced structure."""
-    parts: list = [tuple(seq), num_iterations, tuple(sorted(locked))]
+    traced structure. ``precision`` IS part of the key — the declared
+    mixed-precision recompile trigger (slab/score dtypes change)."""
+    from photon_tpu.ops import precision as precision_mod
+
+    parts: list = [
+        tuple(seq), num_iterations, tuple(sorted(locked)),
+        precision_mod.resolve(precision),
+    ]
     for cid in seq:
         coord = coords[cid]
         if isinstance(coord, ModelCoordinate):
@@ -285,10 +294,20 @@ class FusedFit:
         num_iterations: int,
         locked_coordinates: set[str] | None = None,
         mat_share: dict | None = None,
+        precision: str = "float32",
     ):
+        from photon_tpu.ops import precision as precision_mod
+
         self.seq = list(update_sequence)
         self.num_iterations = num_iterations
         self.locked = set(locked_coordinates or ())
+        # Mixed-precision policy (ops/precision.py): "bfloat16" stores
+        # the materialized slabs AND the per-coordinate score carries in
+        # bf16 (the two dominant per-sweep HBM reads), with f32
+        # accumulators for every row-crossing sum; "float32" (default)
+        # traces the historical program. Part of fused_static_key — the
+        # declared `precision` recompile family.
+        self.precision = precision_mod.resolve(precision)
         self.kinds: dict[str, str] = {}
         self._re_meta: dict[str, dict] = {}
         for cid in self.seq:
@@ -422,7 +441,19 @@ class FusedFit:
             else:
                 plans = list(op["plans"])
                 proj_dev = op["proj_dev"]
-            ebs = tuple(p.materialize(None) for p in plans)
+            from photon_tpu.ops import precision as precision_mod
+
+            # bf16 slab storage (mixed precision): the gather happens
+            # once per dataset generation, so the cast is amortized —
+            # every later sweep reads the slab at half HBM width.
+            ebs = tuple(
+                dataclasses.replace(
+                    eb,
+                    x_values=precision_mod.in_storage(
+                        eb.x_values, self.precision),
+                )
+                for eb in (p.materialize(None) for p in plans)
+            )
             out[cid] = {
                 "ebs": ebs,
                 "score_plans": tuple(
@@ -637,6 +668,34 @@ class FusedFit:
     def _fe_score(self, means, batch):
         return Coefficients(means=means).compute_score(batch.features)
 
+    def _store_score(self, z):
+        """Score-carry storage cast: bf16 under mixed precision (the
+        per-coordinate score vectors are re-read every sweep for the
+        residual algebra — half-width storage halves that traffic), the
+        identity on the default f32 path."""
+        if self.precision == "bfloat16":
+            return z.astype(jnp.bfloat16)
+        return z
+
+    def _quantize_score(self, z):
+        """Round a fresh score through the storage dtype BEFORE it
+        enters the residual total: the f32 total must equal the exact
+        sum of the STORED carries, or each sweep's ``total - old``
+        would leave the carry's quantization residue behind and the
+        residual error would grow linearly with iteration count
+        instead of staying at one rounding (bf16(f32(bf16(z))) ==
+        bf16(z), so the round-trip is idempotent against the stored
+        value). Returns ``z`` itself on the default f32 path."""
+        if self.precision == "bfloat16":
+            return z.astype(jnp.bfloat16).astype(jnp.float32)
+        return z
+
+    @staticmethod
+    def _read_score(zs, dtype):
+        """Upcast a stored score carry back to the f32 accumulator
+        dtype (identity on the default path)."""
+        return zs if zs.dtype == dtype else zs.astype(dtype)
+
     def _fit_fn(self, ops, ebs_all, *, statics):
         num_iters = self.num_iterations
         # Convergence telemetry rides the fit program UNCONDITIONALLY as
@@ -653,6 +712,9 @@ class FusedFit:
         }
 
         # --- initial state ------------------------------------------------
+        # The running TOTAL stays in f32 (it is the accumulator every
+        # residual derives from); the per-coordinate score CARRIES are
+        # stored through _store_score — bf16 under mixed precision.
         states: list = []
         scores: list = []
         diags: list = []
@@ -661,7 +723,7 @@ class FusedFit:
             kind = st[0]
             if kind == "locked":
                 states.append(())
-                scores.append(op["z"])
+                z = op["z"]
                 diags.append(())
             elif kind == "fixed":
                 means = op["w0"]
@@ -672,7 +734,7 @@ class FusedFit:
                     else jnp.zeros_like(means)
                 )
                 states.append((means, variances))
-                scores.append(
+                z = (
                     self._fe_score(means, op["batch"]) if has_init
                     else jnp.zeros(
                         op["batch"].num_samples, means.dtype)
@@ -691,7 +753,7 @@ class FusedFit:
                     else jnp.zeros_like(w_all)
                 )
                 states.append((w_all, v_all))
-                scores.append(
+                z = (
                     self._re_score(w_all, op, ebs_all[self.seq[i]])
                     if has_init
                     else jnp.zeros(
@@ -701,7 +763,9 @@ class FusedFit:
                     jnp.zeros((num_iters, e), jnp.int32),
                     jnp.zeros((num_iters, e), jnp.int32),
                 ))
-            total = scores[-1] if total is None else total + scores[-1]
+            z = self._quantize_score(z)
+            total = z if total is None else total + z
+            scores.append(self._store_score(z))
         conv0 = jnp.zeros(
             (num_iters, len(conv_index), 5), dtype=total.dtype
         )
@@ -715,7 +779,8 @@ class FusedFit:
                 kind = st[0]
                 if kind == "locked":
                     continue
-                residual = total - scores[i]
+                z_old = self._read_score(scores[i], total.dtype)
+                residual = total - z_old
                 if kind == "fixed":
                     _, task, opt_config, use_owlqn, intercept_index, \
                         var_comp = st[:6]
@@ -778,6 +843,7 @@ class FusedFit:
                             variance_computation=var_comp,
                             direct=direct,
                             newton=newton,
+                            precision=self.precision,
                         )
                         its_e = its_e.at[codes].set(its)
                         rs_e = rs_e.at[codes].set(rs)
@@ -797,6 +863,7 @@ class FusedFit:
                     conv_gnorm = jnp.zeros((), total.dtype)
                     conv_wd = jnp.sum((w_all - w_prev) ** 2)
                     conv_wn = jnp.sum(w_all ** 2)
+                z = self._quantize_score(z)
                 # residual_delta_sq: movement of this coordinate's score
                 # contribution this sweep — computed on values the
                 # residual bookkeeping already holds (no extra passes).
@@ -804,13 +871,13 @@ class FusedFit:
                     jnp.stack([
                         conv_loss.astype(total.dtype),
                         conv_gnorm.astype(total.dtype),
-                        jnp.sum((z - scores[i]) ** 2).astype(total.dtype),
+                        jnp.sum((z - z_old) ** 2).astype(total.dtype),
                         conv_wd.astype(total.dtype),
                         conv_wn.astype(total.dtype),
                     ])
                 )
-                total = total - scores[i] + z
-                scores[i] = z
+                total = total - z_old + z
+                scores[i] = self._store_score(z)
             return tuple(states), tuple(scores), total, tuple(diags), conv
 
         carry = (tuple(states), tuple(scores), total, tuple(diags), conv0)
@@ -920,6 +987,18 @@ class FusedFit:
             cost_thunk=lambda: costmodel.program_cost(
                 self.lower(coords)),
         )
+        # Segment-reduce kernel census rows: every instantiation the
+        # tracer recorded (ops/segment_reduce._TRACED_SITES) registers
+        # with its ANALYTIC cost — the kernel executes inside the fused
+        # program, so it has no dispatch row of its own, but the census
+        # prices its roofline next to the programs that embed it
+        # (cli.profile asserts the row exists when the kernel engaged).
+        from photon_tpu.ops import segment_reduce
+
+        for site, info in segment_reduce.traced_sites().items():
+            ledger.register_program(
+                site, phase="score", cost=info["cost"],
+            )
         mat_seconds = 0.0
         if mat_window is not None:
             t0, t1 = mat_window

@@ -52,6 +52,8 @@ from photon_tpu.data.random_effect import (
 from photon_tpu.models.game import RandomEffectModel
 from photon_tpu.ops import glm as glm_ops
 from photon_tpu.ops import losses as losses_mod
+from photon_tpu.ops import precision as precision_mod
+from photon_tpu.ops import segment_reduce
 from photon_tpu.ops.normalization import NormalizationContext
 from photon_tpu.types import TaskType
 
@@ -188,12 +190,16 @@ def _densify_ell_slots(
     """[..., k] slot-ELL -> [..., S] dense via one-hot contraction (NOT
     scatter: batched scatter/gather lowers to a pathologically
     slow-compiling program on TPU; the one-hot einsum compiles in <1s and
-    runs on the MXU). Duplicate slots sum, matching scatter-add."""
+    runs on the MXU). Duplicate slots sum, matching scatter-add (with an
+    f32 accumulator when the values are stored bf16; the densified slab
+    returns to the storage dtype)."""
     onehot = (
         x_indices[..., None]
         == jnp.arange(sub_dim, dtype=x_indices.dtype)
     ).astype(x_values.dtype)
-    return jnp.einsum("...k,...ks->...s", x_values, onehot)
+    return precision_mod.acc_einsum(
+        "...k,...ks->...s", x_values, onehot
+    ).astype(x_values.dtype)
 
 
 def _spd_solve_cg(h: Array, b: Array, sub_dim: int,
@@ -269,7 +275,10 @@ def _solve_one_entity_direct(
     The subspace design matrix is densified per entity (S = sub_dim is small
     by construction — LinearSubspaceProjector compression).
     """
-    dtype = x_values.dtype
+    # Solver STATE (w, H, b, variances) lives in the label dtype (f32);
+    # only the design matrix x may be stored bf16 under mixed precision,
+    # with every row-axis contraction accumulating f32 (acc_einsum).
+    dtype = labels.dtype
     if x_indices is None:
         x = x_values
     else:
@@ -278,14 +287,19 @@ def _solve_one_entity_direct(
         # [R, S] result instead of a [R, k, S] one-hot operand.
         r = x_values.shape[0]
         rows = jnp.broadcast_to(jnp.arange(r)[:, None], x_indices.shape)
-        x = jnp.zeros((r, sub_dim), dtype).at[rows, x_indices].add(x_values)
+        x = jnp.zeros((r, sub_dim), x_values.dtype).at[
+            rows, x_indices].add(x_values)
     if shifts is not None:
-        x = x - shifts[None, :]
+        x = x - precision_mod.like_storage(shifts, x)[None, :]
     if factors is not None:
-        x = x * factors[None, :]
+        x = x * precision_mod.like_storage(factors, x)[None, :]
     y_eff = (labels - offsets) * weights
-    h = x.T @ (x * weights[:, None])
-    b = x.T @ y_eff
+    h = precision_mod.acc_einsum(
+        "rs,rt->st", x * precision_mod.like_storage(weights, x)[:, None], x
+    )
+    b = precision_mod.acc_einsum(
+        "rs,r->s", x, precision_mod.like_storage(y_eff, x)
+    )
     if prior is not None:
         int_onehot = (
             None if shifts is None
@@ -308,8 +322,11 @@ def _solve_one_entity_direct(
     )
     if variance_computation != VarianceComputationType.NONE:
         loss = losses_mod.get_loss(task)
+        # Variances run the deep f32 machinery: upcast a bf16-stored
+        # design (identity on the default path) — variances are a few
+        # tiny solves, not the hot loop.
         batch = GLMBatch(
-            _features_of(x_indices, x_values, sub_dim),
+            _features_of(x_indices, x_values.astype(dtype), sub_dim),
             labels, offsets, weights,
         )
         var_t = variances_in_transformed_space(
@@ -430,12 +447,15 @@ def _solve_newton_batched(
     solver stays on the exact direct path where the solution itself is
     the answer).
     """
-    dtype = x.dtype
+    # Solver state (w, f, g, H, CG iterates) is f32; only the slab x may
+    # be stored bf16 under mixed precision — every contraction against
+    # it reads bf16 and accumulates f32 (ops/precision.py invariant).
+    dtype = labels.dtype
     b = x.shape[0]
     if shifts is not None:
-        x = x - shifts[:, None, :]
+        x = x - precision_mod.like_storage(shifts, x)[:, None, :]
     if factors is not None:
-        x = x * factors[:, None, :]
+        x = x * precision_mod.like_storage(factors, x)[:, None, :]
     loss = losses_mod.get_loss(task)
     iota = jnp.arange(sub_dim)[None, :]
     int_onehot = (
@@ -467,11 +487,16 @@ def _solve_newton_batched(
         l2_diag = l2_weight * penalty_mask
 
     def objective(w):  # w [B, S] -> f [B], g [B, S]
-        z = jnp.einsum("brs,bs->br", x, w) + offsets
+        z = precision_mod.acc_einsum(
+            "brs,bs->br", x, precision_mod.like_storage(w, x)
+        ) + offsets
         f = jnp.sum(weights * loss.loss(z, labels), axis=-1) + 0.5 * jnp.sum(
             l2_diag * (w - m_t) ** 2, axis=-1
         )
-        g = jnp.einsum("brs,br->bs", x, weights * loss.dz(z, labels))
+        g = precision_mod.acc_einsum(
+            "brs,br->bs", x,
+            precision_mod.like_storage(weights * loss.dz(z, labels), x),
+        )
         g = g + l2_diag * (w - m_t)
         return f, g * valid_mask
 
@@ -490,7 +515,10 @@ def _solve_newton_batched(
     from photon_tpu.ops import newton_kernel as nk
 
     r = x.shape[1]
-    if nk.kernel_supported(task, dtype, r, sub_dim):
+    # The fused Newton kernel is f32-only: a bf16-stored slab takes the
+    # batch-minor XLA path below (which reads the slab at half width —
+    # the storage win survives the fallback).
+    if nk.kernel_supported(task, x.dtype, r, sub_dim):
         # Fused Pallas step: the [S, S] Hessians never leave VMEM (the
         # XLA path's padded [B, S, S] HBM round trip was the dominant
         # per-iteration traffic; ops/newton_kernel.py, 3.1x measured).
@@ -570,9 +598,14 @@ def _solve_newton_batched(
     def body(s):
         w, f, g, it, code = s
         active = code == 0
-        z = jnp.einsum("brs,bs->br", x, w) + offsets
+        z = precision_mod.acc_einsum(
+            "brs,bs->br", x, precision_mod.like_storage(w, x)
+        ) + offsets
         curvature = weights * loss.dzz(z, labels)
-        h = jnp.einsum("brs,brt->bst", x * curvature[:, :, None], x)
+        h = precision_mod.acc_einsum(
+            "brs,brt->bst",
+            x * precision_mod.like_storage(curvature, x)[:, :, None], x,
+        )
         h = h + (
             l2_diag[:, :, None] * jnp.eye(sub_dim, dtype=dtype)[None]
             + (1.0 - valid_mask)[:, :, None]
@@ -591,7 +624,9 @@ def _solve_newton_batched(
         d = jnp.where(bad[:, None], -g, d)
         gd = jnp.where(bad, -jnp.sum(g * g, axis=-1), gd)
 
-        zd = jnp.einsum("brs,bs->br", x, d)
+        zd = precision_mod.acc_einsum(
+            "brs,bs->br", x, precision_mod.like_storage(d, x)
+        )
         z_t = z[None] + trial_ts[:, None, None] * zd[None]  # [T, B, R]
         w_t_trials = w[None] + trial_ts[:, None, None] * d[None]  # [T,B,S]
         f_t = jnp.sum(
@@ -651,10 +686,14 @@ def _batched_variances(x_t, labels, offsets, weights, w_t, l2_diag,
     SIMPLE inverts the Hessian diagonal; FULL recovers the inverse
     Hessian's diagonal with one refined batch-minor CG per basis vector.
     """
-    z = jnp.einsum("brs,bs->br", x_t, w_t) + offsets
+    z = precision_mod.acc_einsum(
+        "brs,bs->br", x_t, precision_mod.like_storage(w_t, x_t)
+    ) + offsets
     curv = weights * loss.dzz(z, labels)
     f_sq = 1.0 if factors is None else factors * factors
-    h_diag = jnp.einsum("brs,br->bs", x_t * x_t, curv) + l2_diag
+    h_diag = precision_mod.acc_einsum(
+        "brs,br->bs", x_t * x_t, precision_mod.like_storage(curv, x_t)
+    ) + l2_diag
     dead = h_diag == 0.0  # zero-support, zero-penalty slots: var = inf
     if variance_computation == VarianceComputationType.SIMPLE:
         var_t = 1.0 / jnp.where(dead, jnp.inf, h_diag)
@@ -663,14 +702,17 @@ def _batched_variances(x_t, labels, offsets, weights, w_t, l2_diag,
     # per basis vector (refinement keeps fp32 accuracy at the direct
     # path's level; variance columns are s tiny solves, not the hot loop).
     s = w_t.shape[-1]
-    h = jnp.einsum("brs,brt->bst", x_t * curv[:, :, None], x_t)
-    h = h + l2_diag[:, :, None] * jnp.eye(s, dtype=x_t.dtype)[None]
-    h = h + dead[:, :, None] * jnp.eye(s, dtype=x_t.dtype)[None]
+    h = precision_mod.acc_einsum(
+        "brs,brt->bst",
+        x_t * precision_mod.like_storage(curv, x_t)[:, :, None], x_t,
+    )
+    h = h + l2_diag[:, :, None] * jnp.eye(s, dtype=w_t.dtype)[None]
+    h = h + dead[:, :, None] * jnp.eye(s, dtype=w_t.dtype)[None]
     h_sb = jnp.transpose(h, (1, 2, 0))
     active = jnp.ones(w_t.shape[0], bool)
 
     def col(i, acc):
-        e = jnp.zeros((s, w_t.shape[0]), x_t.dtype).at[i].set(1.0)
+        e = jnp.zeros((s, w_t.shape[0]), w_t.dtype).at[i].set(1.0)
         sol = _spd_solve_cg_sb(h_sb, e, s, active)
         res = e - jnp.sum(h_sb * sol[None, :, :], axis=1)
         sol = sol + _spd_solve_cg_sb(h_sb, res, s, active)
@@ -936,8 +978,17 @@ def _solve_one_entity(
     jax.jit,
     static_argnames=(
         "sub_dim", "task", "opt_config", "use_owlqn", "variance_computation",
-        "direct", "newton",
+        "direct", "newton", "precision",
     ),
+    # Buffer donation through _scatter_results: the [E, Smax] coefficient
+    # and variance tables are CARRIES — each bucket's scatter returns the
+    # updated table and the caller rebinds, so the input buffers are dead
+    # on return. Donating them lets XLA update the tables in place
+    # instead of round-tripping a fresh [E, Smax] allocation per bucket
+    # (inline fused calls ignore donation; the fori_loop carries alias
+    # there instead). Callers must never alias w_all/v_all with another
+    # operand (see warmup_thunks).
+    donate_argnums=(9, 10),
 )
 def _solve_block(
     block,  # EntityBlocks | BlockPlan (pytree structure selects the path)
@@ -959,6 +1010,7 @@ def _solve_block(
     variance_computation: VarianceComputationType,
     direct: bool = False,
     newton: bool = False,
+    precision: str = "float32",
 ):
     """One bucket's batched per-entity solve (everything traced/fused).
 
@@ -984,7 +1036,19 @@ def _solve_block(
                 jnp.take(residuals, block.row_ids, mode="clip"),
                 0.0,
             )
-    dtype = block.x_values.dtype
+    if precision_mod.is_mixed(precision):
+        # bf16 SLAB STORAGE (the mixed-precision policy): the design
+        # slab — the dominant per-iteration HBM read — is held and read
+        # at half width; solver state stays f32 (dtype below) and every
+        # row-axis contraction accumulates f32 (ops/precision.py).
+        block = dataclasses.replace(
+            block,
+            x_values=precision_mod.in_storage(block.x_values, precision),
+        )
+    # Solver state (tables, gradients, Hessians, masks) anchors on the
+    # LABEL dtype, not the slab's: a bf16-stored slab must not narrow
+    # the iterates.
+    dtype = block.labels.dtype
     if (
         block.x_indices is not None
         and sub_dim <= DENSE_SUB_DIM_MAX
@@ -1001,6 +1065,29 @@ def _solve_block(
             x_values=_densify_ell_slots(
                 block.x_indices, block.x_values, sub_dim
             ),
+        )
+    elif block.x_indices is not None and (newton or direct):
+        # Wide-subspace ELL: one flat tiled segment-reduce densifies the
+        # WHOLE bucket (ops/segment_reduce) where the kernel serves this
+        # backend — routing it onto the batched dense solvers instead of
+        # the per-entity vmapped scatter path. None = keep ELL.
+        dense = segment_reduce.densify_ell_blocks(
+            block.x_indices, block.x_values, sub_dim
+        )
+        if dense is not None:
+            block = dataclasses.replace(
+                block, x_indices=None, x_values=dense
+            )
+    if (
+        block.x_values.dtype == jnp.bfloat16
+        and not direct
+        and not (newton and block.x_indices is None)
+    ):
+        # The vmapped quasi-Newton/OWL-QN/ELL-Newton paths run f32 end
+        # to end: upcast the stored slab once inside the program (the
+        # HBM read of the slab is still half-width).
+        block = dataclasses.replace(
+            block, x_values=block.x_values.astype(dtype)
         )
     s = sub_dim
     codes = block.entity_codes
@@ -1171,6 +1258,10 @@ class RandomEffectCoordinate:
     # slots absent from it carry variance 0 and fall back to plain L2
     # (RandomEffectOptimizationProblem.scala:137-198 projected priors).
     prior: RandomEffectModel | None = None
+    # Mixed-precision policy (ops/precision.py): "bfloat16" stores the
+    # design slabs bf16 with f32 accumulators/state; "float32" (default)
+    # is the historical path. A declared recompile key (PERFORMANCE.md).
+    precision: str = "float32"
 
     def _dispatch_block(self, block, residuals, w0_full, w_all, v_all):
         """Assemble and dispatch one bucket's ``_solve_block`` call.
@@ -1230,6 +1321,7 @@ class RandomEffectCoordinate:
             variance_computation=self.config.variance_computation,
             direct=direct,
             newton=newton,
+            precision=precision_mod.resolve(self.precision),
         )
 
     def warmup_thunks(self):
@@ -1252,9 +1344,18 @@ class RandomEffectCoordinate:
         )
 
         def block_thunk(block):
-            return lambda: jax.block_until_ready(self._dispatch_block(
-                block, residuals, w0_full, w0_full, v_all
-            )[0])
+            # w_all/v_all are DONATED by _solve_block: each thunk gets
+            # its own fresh tables — reusing w0_full as w_all would
+            # alias a donated buffer with a live operand, and a shared
+            # v_all would be consumed by the first thunk to run.
+            def thunk():
+                w_tab = jnp.zeros_like(w0_full)
+                v_tab = None if v_all is None else jnp.zeros_like(v_all)
+                jax.block_until_ready(self._dispatch_block(
+                    block, residuals, w0_full, w_tab, v_tab
+                )[0])
+
+            return thunk
 
         def score_thunk():
             model = RandomEffectModel(

@@ -84,6 +84,7 @@ DECLARING_MODULES = (
     "photon_tpu.estimators.game_estimator",
     "photon_tpu.obs",
     "photon_tpu.ops.newton_kernel",
+    "photon_tpu.ops.segment_reduce",
     "photon_tpu.parallel.mesh",
     "photon_tpu.pilot",
     "photon_tpu.resilience",
@@ -537,11 +538,15 @@ def build_fused_fit() -> ContractTrace:
     datasets, _ = est.prepare(data)
     n = data.num_samples
 
-    def fused_for(opt_configs: dict, iters: int = 2):
+    def fused_for(opt_configs: dict, iters: int = 2,
+                  precision: str = "float32"):
         coords = est._build_coordinates(
             datasets, opt_configs, {}, logical_rows=n
         )
-        return FusedFit(coords, est.update_sequence, iters, set()), coords
+        return FusedFit(
+            coords, est.update_sequence, iters, set(),
+            precision=precision,
+        ), coords
 
     def fit_trace(
         fused: FusedFit, coords: dict, initial_models=None, lower=True
@@ -597,6 +602,14 @@ def build_fused_fit() -> ContractTrace:
     variants["iteration_count"].append(
         {"fit": fit_trace(f4, c4, lower=False).signature}
     )
+    # Mixed precision is a DECLARED recompile: bf16 slab/score storage
+    # changes the traced dtypes (ops/precision.py), so the bfloat16
+    # program must differ from the f32 base — and a silent no-op here
+    # (the mixed path quietly tracing f32) fails the contract.
+    f5, c5 = fused_for({}, precision="bfloat16")
+    variants["precision"] = [
+        {"fit": fit_trace(f5, c5, lower=False).signature}
+    ]
 
     return ContractTrace(
         programs={
@@ -625,7 +638,7 @@ def build_fused_cache_keys() -> ContractTrace:
     datasets, _ = est.prepare(data)
     n = data.num_samples
 
-    def key_for(opt_configs: dict) -> str:
+    def key_for(opt_configs: dict, precision: str = "float32") -> str:
         coords = est._build_coordinates(
             datasets, opt_configs, {}, logical_rows=n
         )
@@ -635,6 +648,7 @@ def build_fused_cache_keys() -> ContractTrace:
                 est.update_sequence,
                 est.num_iterations,
                 est.locked_coordinates,
+                precision,
             )
         )
 
@@ -652,7 +666,11 @@ def build_fused_cache_keys() -> ContractTrace:
             )}
         )).signature}
     ]
-    mixed = {sig["fused_static_key"] for sig in lam + swap} | {
+    prec = [
+        {"fused_static_key": TracedProgram(
+            "k", key_for({}, precision="bfloat16")).signature}
+    ]
+    mixed = {sig["fused_static_key"] for sig in lam + swap + prec} | {
         base.signature
     }
     notes = [
@@ -661,7 +679,10 @@ def build_fused_cache_keys() -> ContractTrace:
     ]
     trace = ContractTrace(
         programs={"fused_static_key": base},
-        variants={"lambda_grid": lam, "optimizer_swap": swap},
+        variants={
+            "lambda_grid": lam, "optimizer_swap": swap,
+            "precision": prec,
+        },
         notes=notes,
     )
     if len(mixed) > _FUSED_CACHE_SIZE:
@@ -776,6 +797,57 @@ def build_newton_kernel() -> ContractTrace:
                 {"newton_step": tr("n", trials=8).signature}
             ],
         },
+    )
+
+
+def build_segment_reduce() -> ContractTrace:
+    """The Pallas segment-reduce wrapper, traced through the interpreter
+    path on non-TPU backends (Mosaic lowering is TPU-only). Values, ids
+    and the prefetched starts are traced operands; only the static
+    reduce shape (elements, segments, k_tiles) keys a new executable."""
+    import functools
+    import os
+
+    import jax
+    import numpy as np
+
+    from photon_tpu.ops import segment_reduce as sr
+
+    def tr(name: str, *, m: int, n: int, mult: int = 1) -> TracedProgram:
+        fn = functools.partial(
+            sr.sorted_segment_sum,
+            num_segments=n,
+            multiplicity=mult,
+            interpret=sr.interpret_required(),
+        )
+        return trace_program(
+            name,
+            fn,
+            jax.ShapeDtypeStruct((m,), np.float32),
+            jax.ShapeDtypeStruct((m,), np.int32),
+        )
+
+    # The kernel path must be what gets traced here regardless of the
+    # host's backend: force it for the audit (env restored after).
+    prev = os.environ.get("PHOTON_SEGMENT_KERNEL")
+    os.environ["PHOTON_SEGMENT_KERNEL"] = "force"
+    try:
+        base = tr("segment_sum", m=4096, n=2048)
+        variants = {
+            "reduce_shape": [
+                {"segment_sum": tr("v", m=8192, n=2048).signature},
+                {"segment_sum": tr("v", m=4096, n=2048,
+                                   mult=4).signature},
+            ],
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("PHOTON_SEGMENT_KERNEL", None)
+        else:
+            os.environ["PHOTON_SEGMENT_KERNEL"] = prev
+    return ContractTrace(
+        programs={"segment_sum": base},
+        variants=variants,
     )
 
 
@@ -1965,6 +2037,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_fused_cache_keys": build_fused_cache_keys,
     "build_unfused_update": build_unfused_update,
     "build_newton_kernel": build_newton_kernel,
+    "build_segment_reduce": build_segment_reduce,
     "build_mesh_sharding": build_mesh_sharding,
     "build_ingest_pipeline": build_ingest_pipeline,
     "build_telemetry": build_telemetry,

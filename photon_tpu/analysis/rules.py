@@ -307,6 +307,83 @@ def int32_overflow(ctx: ModuleContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# bf16-accumulation
+# --------------------------------------------------------------------------
+
+# Reductions whose accumulator silently inherits a bf16 operand dtype.
+# ops/segment_reduce.sorted_segment_sum is deliberately absent: its
+# kernel accumulates f32 internally.
+_BF16_REDUCE_PATHS = frozenset(
+    {
+        "jax.numpy.sum",
+        "jax.numpy.einsum",
+        "jax.numpy.dot",
+        "jax.numpy.matmul",
+        "jax.ops.segment_sum",
+    }
+)
+_BF16_PATHS = frozenset({"jax.numpy.bfloat16", "ml_dtypes.bfloat16"})
+_F32_PATHS = frozenset({"jax.numpy.float32", "numpy.float32"})
+
+
+def _mentions_bf16(ctx: ModuleContext, node: ast.AST) -> bool:
+    """A bf16 STORAGE marker anywhere in the operand expression: the
+    jnp.bfloat16 dtype object, the "bfloat16" string literal, an
+    .astype(<bf16>) cast, or the ops.precision storage helpers
+    (in_storage/like_storage/storage_dtype), whose results are bf16 by
+    contract under the mixed policy."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and sub.value == "bfloat16":
+            return True
+        if ctx.resolve(sub) in _BF16_PATHS:
+            return True
+        if isinstance(sub, ast.Call) and isinstance(
+            sub.func, ast.Attribute
+        ) and sub.func.attr in (
+            "in_storage", "like_storage", "storage_dtype"
+        ):
+            return True
+    return False
+
+
+def _f32_accumulator_kwarg(ctx: ModuleContext, call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg in ("dtype", "preferred_element_type"):
+            if ctx.resolve(kw.value) in _F32_PATHS or (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value == "float32"
+            ):
+                return True
+    return False
+
+
+@rule(
+    "bf16-accumulation",
+    "jnp.sum/einsum/dot/segment_sum over a bf16-marked operand with no "
+    "f32 accumulator (dtype=/preferred_element_type=float32) — the "
+    "reduction accumulates in bf16 and loses ~3 decimal digits across "
+    "a row axis; use ops.precision.acc_sum/acc_einsum",
+)
+def bf16_accumulation(ctx: ModuleContext) -> Iterator[Finding]:
+    for call in iter_calls(ctx):
+        if ctx.resolve(call.func) not in _BF16_REDUCE_PATHS:
+            continue
+        if _f32_accumulator_kwarg(ctx, call):
+            continue
+        if not any(_mentions_bf16(ctx, a) for a in call.args):
+            continue
+        yield _finding(
+            ctx,
+            "bf16-accumulation",
+            call,
+            "reduction over a bf16-marked operand accumulates in bf16 "
+            "(f32-accumulator invariant of the mixed-precision policy, "
+            "ops/precision.py): pass dtype=/preferred_element_type="
+            "jnp.float32 or route through precision.acc_sum/acc_einsum",
+        )
+
+
+# --------------------------------------------------------------------------
 # debug-debris
 # --------------------------------------------------------------------------
 

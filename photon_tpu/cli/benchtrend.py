@@ -58,7 +58,17 @@ TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
     "logistic_compile_seconds": ("lower", 1.5, ()),
     "logistic_e2e_seconds": ("lower", 1.5, ()),
     "logistic_warm_cache_e2e_seconds": ("lower", 1.5, ()),
+    # The roofline-push ratchet (ROADMAP item 2, round 15+): the ratio
+    # is measured fit wall over the static roofline bound — LOWER is
+    # closer to the chip's best case, and the trailing-best gate locks
+    # each round's win in (the FLOORS ceiling only caps the absolute
+    # worst case; this line is what makes an improvement permanent).
     "logistic_measured_vs_roofline": ("lower", 1.5, ()),
+    # Achieved HBM throughput of the standalone segment-reduce kernel
+    # dispatch (bench run_kernel_micro; absent on backends the kernel
+    # does not serve — an absent-from-all-history metric is skipped,
+    # but once a TPU round reports it, a silent die fails the trend).
+    "segment_reduce_bytes_per_sec": ("higher", 1.5, ()),
     "serving_p99_ms": ("lower", 1.5, ()),
     "serving_qps": ("higher", 1.5, ()),
     # Streaming scenario (round 10+, photon_tpu.data.stream): the

@@ -156,6 +156,50 @@ def _overhead_ab(
     }
 
 
+def _kernel_probe() -> dict | None:
+    """One REAL dispatch of the tiled segment-reduce kernel under the
+    armed ledger (ops/segment_reduce): registers its census row with the
+    analytic cost and records the measured dispatch->fetch window, so
+    the priced report carries the kernel's own roofline row. Returns
+    None where the kernel does not serve this backend (auto mode off
+    TPU) — the profile-smoke job forces it with
+    ``PHOTON_SEGMENT_KERNEL=force`` to exercise the interpreter path.
+    """
+    import numpy as np
+
+    from photon_tpu.obs import ledger
+    from photon_tpu.ops import segment_reduce as sr
+
+    m = n = 8_192
+    if not sr.kernel_supported(m, n, np.float32):
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    ids = jnp.asarray(np.arange(m, dtype=np.int32))
+    vals = jnp.asarray(
+        np.random.default_rng(0).normal(size=m).astype(np.float32))
+    site = "segment_reduce/probe"
+    # warm (compile outside the measured window)
+    jax.block_until_ready(sr.sorted_segment_sum(
+        vals, ids, n, multiplicity=1, site=site))
+    t0 = time.perf_counter()
+    out = np.asarray(sr.sorted_segment_sum(
+        vals, ids, n, multiplicity=1, site=site))
+    t1 = time.perf_counter()
+    info = sr.traced_sites()[site]
+    ledger.register_program(site, phase="score", cost=info["cost"])
+    ledger.record_dispatch(
+        site, t1 - t0, phase="score", start=t0, end=t1)
+    return {
+        "program": site,
+        "elements": m,
+        "segments": n,
+        "seconds": round(t1 - t0, 6),
+        "checksum": float(out.sum()),
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="photon profile", description=__doc__,
@@ -234,6 +278,10 @@ def main(argv=None) -> int:
     # fit seconds, or a dead fused-fit feed would hide behind them.
     fit_attr = ledger.attribution_since(mark, wall_seconds=fit_wall)
     _serve_pass(result, data)
+    # Kernel probe: where the segment-reduce kernel serves this backend,
+    # one real dispatch prices its census/roofline row into the report
+    # (the profile-smoke job forces the kernel and asserts the row).
+    kernel_probe = _kernel_probe()
     attribution = ledger.attribution_since(mark, wall_seconds=None)
 
     table = ledger.render_top_k(args.top)
@@ -268,6 +316,19 @@ def main(argv=None) -> int:
     if not fit_attr["attributed_fraction"]:
         failures.append(
             "fused-fit wall attributed nothing (ledger feed dead)")
+    if kernel_probe is not None:
+        probe_rows = [
+            r for r in ledger.report()["rows"]
+            if r.get("program") == kernel_probe["program"]
+        ]
+        if not probe_rows:
+            failures.append(
+                "segment-reduce kernel dispatched but its census row is "
+                "missing from the priced report")
+        elif probe_rows[0].get("vs_roofline") is None:
+            failures.append(
+                "segment-reduce census row carries no priced roofline "
+                "(vs_roofline is None — analytic cost missing)")
 
     if args.json:
         doc = {
@@ -279,6 +340,7 @@ def main(argv=None) -> int:
                 **fit_attr,
             },
             "overhead": overhead,
+            "kernel_probe": kernel_probe,
             "failures": failures,
         }
         with open(args.json, "w") as f:

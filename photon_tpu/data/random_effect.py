@@ -96,6 +96,15 @@ class RandomEffectDataConfiguration:
     # of inflating every row's slab. The lazy layout reads the raw feature
     # arrays directly and never builds a table, so the cap is moot there.
     score_table_width_cap: int | None = None
+    # Entity-bucket batching: buckets with fewer member entities than
+    # this merge UPWARD into the next-larger row cap (more padding, but
+    # fewer/fatter solver programs — a bucket-tail of a handful of
+    # entities otherwise dispatches its own program per warm refit and
+    # instantiates its own solver inside the fused sweep). 0 = off (one
+    # bucket per occupied cap, the historical layout). Shared with the
+    # ingest pipeline's shape oracle through ``_assign_buckets`` so
+    # predicted block shapes can never drift from built ones.
+    min_bucket_entities: int = 0
 
 
 @jax.tree_util.register_dataclass
@@ -348,6 +357,9 @@ class RandomEffectDataset:
     score_tail_rows: Array | None = None  # [t] int32
     score_tail_indices: Array | None = None  # [t] int32 subspace slots
     score_tail_values: Array | None = None  # [t]
+    # Host-computed max tail entries per row: the static multiplicity
+    # bound the tiled segment-reduce kernel needs (ops/segment_reduce).
+    score_tail_mult: int | None = None
     # Host mirrors of small per-block plan arrays (one per ``blocks`` entry)
     # so per-fit bookkeeping never pulls from the device.
     block_codes_np: tuple = ()
@@ -987,7 +999,9 @@ def _plan_random_effect(
         intercept_slots_all = np.full(num_entities, -1, dtype=np.int32)
 
     # --- 3. size-bucket membership ----------------------------------------
-    bucket_members = _assign_buckets(counts, active, config.bucket_caps)
+    bucket_members = _assign_buckets(
+        counts, active, config.bucket_caps, config.min_bucket_entities
+    )
     return _Plan(
         codes=codes,
         perm=perm,
@@ -1009,11 +1023,20 @@ def _plan_random_effect(
 
 
 def _assign_buckets(
-    counts: np.ndarray, active: np.ndarray, bucket_caps: tuple
+    counts: np.ndarray,
+    active: np.ndarray,
+    bucket_caps: tuple,
+    min_bucket_entities: int = 0,
 ) -> dict:
     """cap -> member entity codes (ascending), shared between the planner
     and the ingest pipeline's shape oracle (``predict_plan_shapes``) so
-    predicted block shapes can never drift from the built ones."""
+    predicted block shapes can never drift from the built ones.
+
+    ``min_bucket_entities`` > 0 merges undersized buckets UPWARD into
+    the next occupied (or next configured) cap: a warm refit then
+    dispatches fewer, fatter programs instead of paying one launch per
+    bucket-tail. The largest bucket never merges (nothing above holds
+    its rows); merging only ever widens padding, never drops rows."""
     caps = np.asarray(sorted(bucket_caps), dtype=np.int64)
     active_ids = np.nonzero(active)[0]
     r = counts[active_ids]
@@ -1029,9 +1052,27 @@ def _assign_buckets(
     )
     cap_of = np.where(pos < caps.size, caps[np.minimum(pos, caps.size - 1)],
                       pow2)
-    return {
+    members = {
         int(c): active_ids[cap_of == c] for c in np.unique(cap_of)
     }
+    floor = int(min_bucket_entities or 0)
+    if floor > 0 and len(members) > 1:
+        occupied = sorted(members)
+        merged: dict[int, np.ndarray] = {}
+        pending: np.ndarray | None = None
+        for i, cap in enumerate(occupied):
+            ids = members[cap]
+            if pending is not None:
+                ids = np.union1d(pending, ids)
+                pending = None
+            if ids.size < floor and i < len(occupied) - 1:
+                pending = ids  # tail rides up into the next bucket
+            else:
+                # The largest bucket always lands here (its cap holds
+                # every smaller entity's rows), so no tail is dropped.
+                merged[cap] = ids
+        members = merged
+    return members
 
 
 def _split_packed_impl(buf, shapes):
@@ -1430,7 +1471,9 @@ def predict_plan_shapes(
         counts_full if upper is None else np.minimum(counts_full, upper)
     )
     active = counts >= (lower or 1)
-    bucket_members = _assign_buckets(counts, active, config.bucket_caps)
+    bucket_members = _assign_buckets(
+        counts, active, config.bucket_caps, config.min_bucket_entities
+    )
     any_active = bool(active.any())
     max_sub_dim = d if any_active else 1
     buckets = [
@@ -1745,10 +1788,16 @@ def build_random_effect_dataset(
         config.score_table_width_cap, tail_in=ell_tail,
     )
     tail_r = tail_i = tail_v = None
+    tail_mult = None
     if tail is not None:
         tail_r = jnp.asarray(tail[0].astype(np.int32))
         tail_i = jnp.asarray(tail[1].astype(np.int32))
         tail_v = jnp.asarray(tail[2], dtype=dtype)
+        # Static per-row multiplicity bound for the tiled segment-reduce
+        # (tail rows are sorted, so one bincount prices the worst row).
+        tail_mult = (
+            int(np.bincount(tail[0]).max()) if tail[0].size else 1
+        )
 
     return RandomEffectDataset(
         config=config,
@@ -1766,6 +1815,7 @@ def build_random_effect_dataset(
         score_tail_rows=tail_r,
         score_tail_indices=tail_i,
         score_tail_values=tail_v,
+        score_tail_mult=tail_mult,
         block_codes_np=tuple(bh["members"] for bh in bucket_host),
         block_intercepts_np=tuple(bh["intercepts"] for bh in bucket_host),
         covered_np=covered_np,

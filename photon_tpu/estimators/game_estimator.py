@@ -119,7 +119,7 @@ PROGRAM_AUDIT = [
         builder="build_fused_cache_keys",
         max_programs=1,
         stable_under=("lambda_grid",),
-        recompiles_on=("optimizer_swap",),
+        recompiles_on=("optimizer_swap", "precision"),
     ),
     dict(
         name="unfused-coordinate-update",
@@ -275,6 +275,7 @@ class GameEstimator:
         mesh="auto",
         listeners=None,
         non_finite_guard: bool = False,
+        precision: str = "float32",
     ):
         self.task = task
         self.coordinate_configs = dict(coordinate_configs)
@@ -307,6 +308,15 @@ class GameEstimator:
         # loop (needs a host boundary per update, so it rides the
         # unfused path — see fit()'s fused gating).
         self.non_finite_guard = bool(non_finite_guard)
+        # Mixed-precision policy (ops/precision.py; PERFORMANCE.md):
+        # "bfloat16" stores random-effect slabs + fused score carries in
+        # bf16 with f32 accumulators everywhere a sum crosses a row
+        # axis; "float32" (default) is the historical path. Part of the
+        # fused static key — the declared `precision` recompile family
+        # (the λ grid still adds ZERO programs at either setting).
+        from photon_tpu.ops import precision as _precision_mod
+
+        self.precision = _precision_mod.resolve(precision)
         # Training-event fan-out (events.EventEmitter listener registry):
         # CoordinateUpdateEvent per coordinate update, FitEndEvent per
         # optimization config (EventEmitter.scala:24 for the GAME path).
@@ -546,6 +556,7 @@ class GameEstimator:
                     opt,
                     self._shard_norm(cfg.data.feature_shard_id),
                     prior=priors.get(cid),
+                    precision=self.precision,
                 )
             else:
                 problem = GLMOptimizationProblem(
@@ -647,7 +658,7 @@ class GameEstimator:
             return None
         key = fused_static_key(
             coords, self.update_sequence, self.num_iterations,
-            self.locked_coordinates,
+            self.locked_coordinates, self.precision,
         )
         cache = getattr(self, "_fused_cache", None)
         share = getattr(self, "_fused_mat_share", None)
@@ -666,6 +677,7 @@ class GameEstimator:
             coords, self.update_sequence, self.num_iterations,
             self.locked_coordinates,
             mat_share=share,
+            precision=self.precision,
         )
         fused.static_key = key
         cache[key] = fused
@@ -762,10 +774,11 @@ class GameEstimator:
             fused = FusedFit(
                 coords, self.update_sequence, self.num_iterations,
                 self.locked_coordinates,
+                precision=self.precision,
             )
             key = fused_static_key(
                 coords, self.update_sequence, self.num_iterations,
-                self.locked_coordinates,
+                self.locked_coordinates, self.precision,
             )
             with PIPELINE_STATS.stage("compile"):
                 art = fused.aot_lower(coords)

@@ -24,6 +24,8 @@ import numpy as np
 
 from photon_tpu.data.random_effect import RandomEffectDataset
 from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.ops import precision as precision_mod
+from photon_tpu.ops import segment_reduce
 from photon_tpu.types import TaskType
 
 Array = jax.Array
@@ -103,6 +105,7 @@ class RandomEffectModel:
             dataset.score_indices,
             dataset.score_values,
             tail,
+            tail_multiplicity=getattr(dataset, "score_tail_mult", None),
         )
 
 
@@ -120,7 +123,16 @@ def _bucket_score_add(z, x_slab, row_ids, row_counts, codes, w):
     s = x_slab.shape[-1]
     valid = jnp.arange(r, dtype=jnp.int32)[None, :] < row_counts[:, None]
     we = jnp.take(w, codes, axis=0, mode="clip")[:, :s].astype(x_slab.dtype)
-    zb = jnp.einsum("brs,bs->br", x_slab, we)
+    # f32 accumulator whenever the slab is stored bf16 (ops/precision.py
+    # mixed-precision invariant); on f32 slabs this is the plain einsum.
+    zb = precision_mod.acc_einsum("brs,bs->br", x_slab, we)
+    if segment_reduce.kernel_supported(
+        int(np.prod(row_ids.shape)), int(z.shape[0]), zb.dtype
+    ):
+        # Tiled segment-reduce instead of the serialized scatter-add:
+        # valid row ids are distinct within one bucket (each kept row
+        # belongs to exactly one entity), so multiplicity is 1.
+        return segment_reduce.scatter_add_rows(z, row_ids, zb, valid)
     zb = jnp.where(valid, zb, 0.0)
     return z.at[row_ids].add(zb.astype(z.dtype))
 
@@ -178,12 +190,17 @@ def _score_via_buckets(w: Array, ds: RandomEffectDataset) -> Array | None:
 
 
 def bucket_score_parts(w, slabs, codes):
-    """Per-bucket flat [B*cap] score vectors (slab GEMM per bucket)."""
+    """Per-bucket flat [B*cap] score vectors (slab GEMM per bucket).
+
+    bf16-stored slabs accumulate their score reduction in f32
+    (ops/precision.py); the parts come back f32 either way."""
     parts = []
     for xv, cd in zip(slabs, codes):
         we = jnp.take(w, cd, axis=0, mode="clip")[:, :xv.shape[-1]].astype(
             xv.dtype)
-        parts.append(jnp.einsum("brs,bs->br", xv, we).reshape(-1))
+        parts.append(
+            precision_mod.acc_einsum("brs,bs->br", xv, we).reshape(-1)
+        )
     return parts
 
 
@@ -263,11 +280,13 @@ def score_entity_table(
         onehot = (
             indices[:, :, None]
             == jnp.arange(s, dtype=indices.dtype)[None, None, :]
-        ).astype(values.dtype)  # [n, k, S]
+        ).astype(rows.dtype)  # [n, k, S]
         picked = jnp.einsum("nks,ns->nk", onehot, rows)
     else:
         picked = jnp.take_along_axis(rows, indices, axis=-1)  # [n, k]
-    return jnp.sum(values * picked, axis=-1)
+    return precision_mod.acc_sum(
+        precision_mod.like_storage(values, picked) * picked, axis=-1
+    )
 
 
 @jax.jit
@@ -291,7 +310,9 @@ def _score_raw_dense(w: Array, codes: Array, x: Array, proj: Array) -> Array:
         w_orig, jnp.maximum(codes, 0), axis=0, mode="fill", fill_value=0
     )
     rows = jnp.where((codes >= 0)[:, None], rows, 0)
-    return jnp.sum(x.astype(w.dtype) * rows, axis=-1)
+    # Row-axis reduction: f32 accumulator when the table is stored bf16
+    # (the serving precision path); identical to the plain sum at f32.
+    return precision_mod.acc_sum(x.astype(w.dtype) * rows, axis=-1)
 
 
 @jax.jit
@@ -321,7 +342,12 @@ def _score_raw_sparse(
         ).astype(values.dtype)  # [n, k, S]
         contrib = jnp.einsum("nk,nks->ns", values, onehot)
         return jnp.where(
-            known, jnp.einsum("ns,ns->n", contrib, wrows), 0.0
+            known,
+            precision_mod.acc_einsum(
+                "ns,ns->n", precision_mod.like_storage(contrib, wrows),
+                wrows,
+            ),
+            0.0,
         )
     sentinel = jnp.iinfo(jnp.int32).max
     psort = jnp.where(proj >= 0, proj, sentinel)  # [E, S], stays ascending
@@ -332,7 +358,12 @@ def _score_raw_sparse(
     slot = jnp.minimum(slot, s - 1)
     hit = (jnp.take_along_axis(prows, slot, axis=1) == indices) & known[:, None]
     picked = jnp.take_along_axis(wrows, slot, axis=1)
-    return jnp.sum(jnp.where(hit, values * picked, 0.0), axis=-1)
+    return precision_mod.acc_sum(
+        jnp.where(
+            hit, precision_mod.like_storage(values, picked) * picked, 0.0
+        ),
+        axis=-1,
+    )
 
 
 def score_raw_features(
@@ -372,10 +403,17 @@ def score_entity_table_with_tail(
     indices: Array,
     values: Array,
     tail: tuple[Array, Array, Array] | None,
+    tail_multiplicity: int | None = None,
 ) -> Array:
     """score_entity_table plus a width-capped table's COO overflow tail
     (rows sorted ascending; see RandomEffectDataConfiguration
-    .score_table_width_cap)."""
+    .score_table_width_cap).
+
+    ``tail_multiplicity`` is the host-computed max tail entries per row
+    (RandomEffectDataset.score_tail_mult): with it, the sorted tail
+    reduction runs through the tiled Pallas segment-reduce where
+    supported instead of the XLA scatter lowering of ``segment_sum``.
+    """
     base = score_entity_table(w, codes, indices, values)
     if tail is None or w.shape[0] == 0:
         return base
@@ -383,9 +421,23 @@ def score_entity_table_with_tail(
     # Flattened 1-D take instead of a two-vector gather (compile cost).
     flat = jnp.take(codes, tr) * w.shape[1] + ti
     picked = jnp.take(w.reshape(-1), flat)
-    return base + jax.ops.segment_sum(
-        tv * picked, tr, num_segments=base.shape[0], indices_are_sorted=True
-    )
+    contrib = precision_mod.like_storage(tv, picked) * picked
+    n = base.shape[0]
+    if tail_multiplicity is not None and segment_reduce.kernel_supported(
+        int(tr.shape[0]), int(n), contrib.dtype
+    ):
+        summed = segment_reduce.sorted_segment_sum(
+            contrib, tr.astype(jnp.int32), n,
+            multiplicity=int(tail_multiplicity),
+            site="segment_reduce/score_tail",
+        )
+    else:
+        if contrib.dtype == jnp.bfloat16:
+            contrib = contrib.astype(jnp.float32)  # f32 accumulator
+        summed = jax.ops.segment_sum(
+            contrib, tr, num_segments=n, indices_are_sorted=True
+        )
+    return base + summed.astype(base.dtype)
 
 
 @dataclasses.dataclass(frozen=True)

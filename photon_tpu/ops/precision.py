@@ -1,0 +1,117 @@
+"""Mixed-precision policy for the fused GLMix hot path.
+
+The fused fit is dispatch/layout-bound at f32 (BENCH_r05: ~0.03% of
+bf16 peak, ~4.6% of HBM peak); the biggest per-sweep HBM reads are the
+materialized bucket slabs and the per-coordinate score/residual
+vectors. ``precision="bfloat16"`` stores those in bf16 — halving the
+slab and score traffic — while every sum that crosses a row axis
+(losses, gradients, Hessians, margins, score reductions, convergence
+diagnostics) accumulates in float32.
+
+The policy is a STRING plumbed explicitly (GameEstimator(precision=)
+-> FusedFit -> _solve_block statics), never ambient state: the traced
+program depends only on operand dtypes and the static precision key,
+so the tier-2 contracts can pin that "float32" (the default) traces
+byte-identical programs to the pre-policy code and that "bfloat16" is
+a DECLARED recompile key (``recompiles_on=("precision",)``).
+
+The accumulate helpers below are dtype-driven: on f32 operands they
+are literally the plain ``jnp`` call (identical jaxpr — the default
+path cannot drift), on bf16 operands they force an f32 accumulator via
+``preferred_element_type`` / ``dtype=``. The tier-1
+``bf16-accumulation`` rule (analysis/rules.py) flags raw
+``jnp.sum``/``einsum``/segment-reduce calls on bf16-marked operands in
+the fused-fit modules — these helpers are the sanctioned spelling.
+
+Precision policy table, per-family tolerances, and the donation map
+live in PERFORMANCE.md.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+FLOAT32 = "float32"
+BFLOAT16 = "bfloat16"
+
+_ALIASES = {
+    "float32": FLOAT32,
+    "f32": FLOAT32,
+    "fp32": FLOAT32,
+    "bfloat16": BFLOAT16,
+    "bf16": BFLOAT16,
+    "mixed_bf16": BFLOAT16,
+}
+
+
+def resolve(name: str | None) -> str:
+    """Normalize a precision name; the default is the f32 path."""
+    if name is None:
+        return FLOAT32
+    key = str(name).lower()
+    if key not in _ALIASES:
+        raise ValueError(
+            f"unknown precision {name!r}: expected one of "
+            f"{sorted(set(_ALIASES))}")
+    return _ALIASES[key]
+
+
+def is_mixed(name: str | None) -> bool:
+    return resolve(name) == BFLOAT16
+
+
+def storage_dtype(name: str | None):
+    """The dtype large reused operands (slabs, score vectors, serving
+    coefficient tables) are STORED in under this policy."""
+    return jnp.bfloat16 if is_mixed(name) else jnp.float32
+
+
+def in_storage(x: Array, name: str | None) -> Array:
+    """Cast a float operand to the policy's storage dtype (identity on
+    the default path and for non-float operands)."""
+    if is_mixed(name) and jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(jnp.bfloat16)
+    return x
+
+
+def _any_bf16(ops) -> bool:
+    return any(
+        getattr(o, "dtype", None) == jnp.bfloat16 for o in ops
+    )
+
+
+def acc_einsum(spec: str, *ops: Array) -> Array:
+    """einsum whose accumulator is f32 whenever any operand is bf16.
+
+    On all-f32 operands this is EXACTLY ``jnp.einsum(spec, *ops)`` —
+    same jaxpr, so the default path is untouched by construction. On
+    bf16 operands the contraction reads bf16 (the bandwidth win) and
+    accumulates f32 (the correctness invariant); the result is f32.
+    """
+    if _any_bf16(ops):
+        return jnp.einsum(
+            spec, *ops, preferred_element_type=jnp.float32
+        )
+    return jnp.einsum(spec, *ops)
+
+
+def acc_sum(x: Array, axis=None, keepdims: bool = False) -> Array:
+    """sum with an f32 accumulator whenever the operand is bf16."""
+    if getattr(x, "dtype", None) == jnp.bfloat16:
+        return jnp.sum(  # photon: ignore[bf16-accumulation] -- this IS the sanctioned f32-accumulator spelling (dtype=float32)
+            x, axis=axis, keepdims=keepdims, dtype=jnp.float32
+        )
+    return jnp.sum(x, axis=axis, keepdims=keepdims)
+
+
+def like_storage(x: Array, ref: Array) -> Array:
+    """Cast ``x`` to ``ref``'s dtype when ``ref`` is a bf16-stored
+    operand (the contraction-partner cast: einsum on mixed dtypes would
+    otherwise PROMOTE the stored operand back to f32 and re-read the
+    full-width slab)."""
+    if getattr(ref, "dtype", None) == jnp.bfloat16:
+        return x.astype(jnp.bfloat16)
+    return x

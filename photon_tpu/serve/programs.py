@@ -227,7 +227,15 @@ class ScorePrograms:
             if self._fe_names
             else tables.random[self._re_names[0]].weights
         )
-        self.dtype = np.dtype(str(w0.dtype))
+        # Request/feature payload dtype: always a numpy-native float —
+        # bf16-stored TABLES narrow the gathered coefficient rows, not
+        # the request payloads (the score kernels cast features to the
+        # table dtype at the contraction and accumulate f32).
+        self.dtype = (
+            np.dtype(np.float32)
+            if str(w0.dtype) == "bfloat16"
+            else np.dtype(str(w0.dtype))
+        )
 
         shard_idx = {s: i for i, s in enumerate(self.shard_order)}
         fe_feat = tuple(shard_idx[s] for s in fe_shards)
@@ -248,14 +256,17 @@ class ScorePrograms:
                 _score_raw_dense,
                 _score_raw_sparse,
             )
+            from photon_tpu.ops import precision as precision_mod
 
             total = None
             for w, fi in zip(fe_ws, fe_feat):
                 if spec_kinds[fi] == "dense":
-                    z = feats[fi].astype(w.dtype) @ w
+                    z = precision_mod.acc_einsum(
+                        "bd,d->b", feats[fi].astype(w.dtype), w
+                    )
                 else:
                     idx, val = feats[fi]
-                    z = jnp.sum(
+                    z = precision_mod.acc_sum(
                         val.astype(w.dtype) * jnp.take(w, idx), axis=-1
                     )
                 total = z if total is None else total + z

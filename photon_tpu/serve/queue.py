@@ -598,7 +598,9 @@ class MicroBatchQueue:
         from photon_tpu.serve.tables import CoefficientTables
 
         tables = self.programs.tables
-        new = CoefficientTables.from_game_model(model)
+        # Build the candidate at the LIVE precision: a bf16-serving
+        # queue reloading an f32-trained model must stay values-only.
+        new = CoefficientTables.from_game_model(model, tables.precision)
         if tables._values_only_delta(new):
             tables._reload_built(new)
             return {

@@ -124,6 +124,12 @@ class CoefficientTables:
     # ``health()`` so an operator can confirm which coefficient
     # generation is live without comparing arrays.
     generation: int = 0
+    # Serving precision (ops/precision.py): "bfloat16" stores the
+    # coefficient tables at half width — the score programs read bf16
+    # and accumulate f32 (models/game.py acc_* helpers). Reloads build
+    # the candidate generation at the SAME precision, so a values-only
+    # refresh keeps dtypes (and with them the zero-recompile contract).
+    precision: str = "float32"
 
     @property
     def coordinate_order(self) -> tuple[str, ...]:
@@ -174,9 +180,23 @@ class CoefficientTables:
         }
 
     @staticmethod
-    def from_game_model(model: GameModel) -> "CoefficientTables":
+    def from_game_model(
+        model: GameModel, precision: str = "float32"
+    ) -> "CoefficientTables":
         import jax
         import jax.numpy as jnp
+
+        from photon_tpu.ops import precision as precision_mod
+
+        resolved = precision_mod.resolve(precision)
+
+        def put(arr):
+            # bf16 table storage (serving mixed precision): half the
+            # resident HBM and half the gather width per request; the
+            # score kernels accumulate f32 (models/game.py).
+            return jax.device_put(
+                precision_mod.in_storage(jnp.asarray(arr), resolved)
+            )
 
         fixed: dict[str, FixedTable] = {}
         random: dict[str, RandomTable] = {}
@@ -186,9 +206,7 @@ class CoefficientTables:
                     name=name,
                     feature_shard_id=sub.feature_shard_id,
                     task=sub.task,
-                    weights=jax.device_put(
-                        jnp.asarray(sub.model.coefficients.means)
-                    ),
+                    weights=put(sub.model.coefficients.means),
                 )
             elif isinstance(sub, RandomEffectModel):
                 keys = tuple(str(k) for k in sub.entity_keys)
@@ -197,7 +215,7 @@ class CoefficientTables:
                     random_effect_type=sub.random_effect_type,
                     feature_shard_id=sub.feature_shard_id,
                     task=sub.task,
-                    weights=jax.device_put(jnp.asarray(sub.coefficients)),
+                    weights=put(sub.coefficients),
                     proj=jax.device_put(
                         jnp.asarray(
                             np.asarray(sub.proj_all).astype(np.int32)
@@ -209,7 +227,8 @@ class CoefficientTables:
             else:
                 raise TypeError(f"unknown sub-model type for {name!r}")
         tables = CoefficientTables(
-            fixed=fixed, random=random, task=model.task
+            fixed=fixed, random=random, task=model.task,
+            precision=resolved,
         )
         tables.account_resident()
         return tables
@@ -297,7 +316,8 @@ class CoefficientTables:
         rebuild its score programs if shapes changed.
         """
         return self._reload_built(
-            CoefficientTables.from_game_model(model), donate=donate
+            CoefficientTables.from_game_model(model, self.precision),
+            donate=donate,
         )
 
     def _reload_built(
@@ -365,7 +385,7 @@ class CoefficientTables:
 
         new = (
             prebuilt if prebuilt is not None
-            else CoefficientTables.from_game_model(model)
+            else CoefficientTables.from_game_model(model, self.precision)
         )
         if self._values_only_delta(new):
             self._reload_built(new)

@@ -75,8 +75,14 @@ def _clean_retry_stats():
     policy: serve-tap sketches, parked numerics sentinels, and the
     enable flag are process-global, and a prior test's armed pilot run
     must not leak a sketch (or the armed flag) into its successors.
+
+    The segment-reduce kernel's trace-time site registry
+    (``ops.segment_reduce._TRACED_SITES``) is cleared too: a forced-
+    kernel test's traced shapes must not register phantom census rows
+    when a LATER test runs a ledger-armed fused fit.
     """
     from photon_tpu.obs import health, ledger
+    from photon_tpu.ops import segment_reduce
     from photon_tpu.resilience.retry import reset_retry_stats
 
     reset_retry_stats()
@@ -84,4 +90,5 @@ def _clean_retry_stats():
     ledger.disable()
     health.reset()
     health.disable()
+    segment_reduce._TRACED_SITES.clear()
     yield

@@ -1,0 +1,41 @@
+"""Fixture: bf16-accumulation — bf16-marked reductions without an f32
+accumulator (positive, f32-kwarg-clean, suppressed, and f32 variants)."""
+import jax
+import jax.numpy as jnp
+
+
+def positive_sum(x):
+    x16 = x.astype(jnp.bfloat16)
+    return jnp.sum(x16.astype(jnp.bfloat16))  # EXPECT: bf16-accumulation
+
+
+def positive_einsum(x, w):
+    return jnp.einsum(  # EXPECT: bf16-accumulation
+        "br,r->b", x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+    )
+
+
+def positive_dtype_string(x):
+    return jnp.sum(x.astype("bfloat16"))  # EXPECT: bf16-accumulation
+
+
+def positive_segment_sum(vals, ids):
+    return jax.ops.segment_sum(  # EXPECT: bf16-accumulation
+        vals.astype(jnp.bfloat16), ids, num_segments=8
+    )
+
+
+def clean_f32_accumulator(x, w):
+    z = jnp.einsum(
+        "br,r->b", x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.sum(x.astype(jnp.bfloat16), dtype=jnp.float32) + z[0]
+
+
+def clean_f32_operand(x):
+    return jnp.sum(x.astype(jnp.float32))
+
+
+def suppressed_sum(x):
+    return jnp.sum(x.astype(jnp.bfloat16))  # photon: ignore[bf16-accumulation] -- fixture: demonstrates the reasoned suppression form

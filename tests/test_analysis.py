@@ -44,6 +44,7 @@ REQUIRED_RULES = frozenset(
         "float64-literal",
         "int32-overflow",
         "debug-debris",
+        "bf16-accumulation",
     }
 )
 

@@ -1,0 +1,448 @@
+"""Mixed-precision (ops/precision.py) — policy units and numerical
+parity of the bf16 fused fit against the f32 reference on all four GLM
+families, plus the serving precision path and the entity-bucket batching
+knob that ride the same PR.
+
+Tolerances here are the DOCUMENTED contract (PERFORMANCE.md): bf16
+stores ~8 mantissa bits, so coefficient tables agree to ~1e-2 relative
+and per-row scores to ~5e-2 absolute at unit scale. The hinge family
+upcasts its vmapped solver (no batched-Newton path), so only score/
+residual storage rounds there.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu import optim
+from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
+from photon_tpu.data.dataset import DenseFeatures
+from photon_tpu.data.game_data import make_game_dataset
+from photon_tpu.data.random_effect import (
+    RandomEffectDataConfiguration,
+    _assign_buckets,
+)
+from photon_tpu.estimators.game_estimator import (
+    FixedEffectCoordinateConfiguration,
+    GameEstimator,
+    RandomEffectCoordinateConfiguration,
+)
+from photon_tpu.ops import precision as px
+from photon_tpu.types import TaskType
+
+
+class TestPolicy:
+    def test_resolve_aliases(self):
+        assert px.resolve(None) == "float32"
+        assert px.resolve("f32") == "float32"
+        assert px.resolve("bf16") == "bfloat16"
+        assert px.resolve("BFLOAT16") == "bfloat16"
+        with pytest.raises(ValueError, match="unknown precision"):
+            px.resolve("float16")
+
+    def test_storage_and_cast(self):
+        x = jnp.ones(4, jnp.float32)
+        assert px.in_storage(x, "float32") is x
+        assert px.in_storage(x, "bfloat16").dtype == jnp.bfloat16
+        ids = jnp.ones(4, jnp.int32)
+        assert px.in_storage(ids, "bfloat16") is ids  # non-float: kept
+
+    def test_acc_einsum_accumulates_f32_on_bf16(self):
+        a = jnp.ones((3, 5), jnp.bfloat16)
+        b = jnp.ones(5, jnp.bfloat16)
+        out = px.acc_einsum("rs,s->r", a, b)
+        assert out.dtype == jnp.float32
+        # f32 path is the PLAIN einsum (identical program/result dtype)
+        out32 = px.acc_einsum(
+            "rs,s->r", a.astype(jnp.float32), b.astype(jnp.float32))
+        assert out32.dtype == jnp.float32
+
+    def test_acc_sum_bf16_accumulates_f32(self):
+        # 4096 ones: a bf16 accumulator stalls once the partial sum
+        # outgrows the increment's 8 mantissa bits (backend-dependent —
+        # some CPUs upcast reduces internally, TPUs do not, which is
+        # exactly why the invariant is spelled explicitly).
+        x = jnp.ones(4096, jnp.bfloat16)
+        out = px.acc_sum(x)
+        assert out.dtype == jnp.float32
+        assert float(out) == 4096.0
+        # f32 operands take the PLAIN sum (dtype preserved, no convert)
+        assert px.acc_sum(jnp.ones(8, jnp.float32)).dtype == jnp.float32
+
+    def test_like_storage(self):
+        ref16 = jnp.ones(2, jnp.bfloat16)
+        ref32 = jnp.ones(2, jnp.float32)
+        x = jnp.ones(2, jnp.float32)
+        assert px.like_storage(x, ref16).dtype == jnp.bfloat16
+        assert px.like_storage(x, ref32) is x
+
+
+def _l2(w):
+    return GLMOptimizationConfiguration(
+        regularization=optim.RegularizationContext(
+            optim.RegularizationType.L2
+        ),
+        regularization_weight=w,
+    )
+
+
+def _workload(task: TaskType, seed=0):
+    rng = np.random.default_rng(seed)
+    n, d, du, users = 3_000, 8, 5, 40
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    x[:, -1] = 1.0
+    xu = rng.normal(size=(n, du)).astype(np.float32)
+    xu[:, -1] = 1.0
+    uid = rng.integers(0, users, n)
+    w = 0.3 * rng.normal(size=d).astype(np.float32)
+    wu = 0.3 * rng.normal(size=(users, du)).astype(np.float32)
+    z = x @ w + np.einsum("nd,nd->n", xu, wu[uid])
+    if task == TaskType.LOGISTIC_REGRESSION:
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(
+            np.float32)
+    elif task == TaskType.POISSON_REGRESSION:
+        y = rng.poisson(np.exp(np.clip(0.3 * z, -3, 3))).astype(
+            np.float32)
+    elif task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        y = (z > 0).astype(np.float32)
+    else:
+        y = (z + 0.2 * rng.normal(size=n)).astype(np.float32)
+    return make_game_dataset(
+        y, {"g": DenseFeatures(x), "u": DenseFeatures(xu)},
+        id_tags={"userId": uid},
+    )
+
+
+def _fit(task, data, precision):
+    est = GameEstimator(
+        task,
+        {
+            "global": FixedEffectCoordinateConfiguration("g", _l2(1e-2)),
+            "per-user": RandomEffectCoordinateConfiguration(
+                RandomEffectDataConfiguration("userId", "u"), _l2(1.0)
+            ),
+        },
+        num_iterations=2,
+        mesh="off",
+        precision=precision,
+    )
+    result = est.fit(data)[0]
+    return est, result.model
+
+
+def _rel_err(a, b):
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    scale = max(float(np.abs(b).max()), 1e-9)
+    return float(np.abs(a - b).max()) / scale
+
+
+# The documented per-family tolerance table (PERFORMANCE.md): max
+# relative coefficient error of the bf16 fused fit vs the f32 reference.
+FAMILY_RTOL = {
+    TaskType.LINEAR_REGRESSION: 2e-2,
+    TaskType.LOGISTIC_REGRESSION: 2e-2,
+    TaskType.POISSON_REGRESSION: 3e-2,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: 2e-2,
+}
+
+
+class TestBf16Parity:
+    @pytest.mark.parametrize(
+        "task", sorted(FAMILY_RTOL, key=lambda t: t.name),
+        ids=lambda t: t.name.lower(),
+    )
+    def test_fused_fit_parity(self, task):
+        data = _workload(task)
+        est32, m32 = _fit(task, data, "float32")
+        est16, m16 = _fit(task, data, "bfloat16")
+        # Both runs rode the FUSED whole-fit path (the parity claim is
+        # about the fused programs, not a silent unfused fallback).
+        assert est32._fused_cache and est16._fused_cache
+        rtol = FAMILY_RTOL[task]
+        fe_err = _rel_err(
+            m16.models["global"].model.coefficients.means,
+            m32.models["global"].model.coefficients.means,
+        )
+        re_err = _rel_err(
+            m16.models["per-user"].coefficients,
+            m32.models["per-user"].coefficients,
+        )
+        assert fe_err <= rtol, (task, fe_err)
+        assert re_err <= rtol, (task, re_err)
+
+    def test_score_quantization_is_idempotent_against_storage(self):
+        # The residual-drift guard (review finding): the f32 total must
+        # accumulate values that round-trip EXACTLY through the bf16
+        # carry storage — bf16(f32(bf16(z))) == bf16(z) — so a
+        # converged coordinate's `total - read(store(z))` is exactly 0
+        # every sweep instead of leaking one rounding per iteration.
+        from photon_tpu.algorithm.fused_fit import FusedFit
+
+        rng = np.random.default_rng(0)
+        z = jnp.asarray(rng.normal(size=512).astype(np.float32))
+        q = FusedFit._quantize_score
+        f = type("F", (), {"precision": "bfloat16",
+                           "_quantize_score": q})()
+        zq = f._quantize_score(z)
+        # idempotent: storing the quantized value loses nothing more
+        np.testing.assert_array_equal(
+            np.asarray(zq),
+            np.asarray(zq.astype(jnp.bfloat16).astype(jnp.float32)),
+        )
+        # and the f32 path is the SAME OBJECT (no trace perturbation)
+        f32 = type("F", (), {"precision": "float32",
+                             "_quantize_score": q})()
+        assert f32._quantize_score(z) is z
+
+    def test_warm_start_reenters_same_program(self):
+        # bf16 warm start must reuse the bf16 executables — λ-grid-style
+        # re-entry, zero extra fused cache keys.
+        data = _workload(TaskType.LOGISTIC_REGRESSION)
+        est, model = _fit(TaskType.LOGISTIC_REGRESSION, data, "bf16")
+        keys_before = set(est._fused_cache)
+        est.fit(data, initial_model=model)
+        assert set(est._fused_cache) == keys_before
+
+
+class TestStaticKey:
+    def test_precision_is_a_recompile_key(self):
+        from photon_tpu.algorithm.fused_fit import fused_static_key
+
+        data = _workload(TaskType.LINEAR_REGRESSION)
+        est, _ = _fit(TaskType.LINEAR_REGRESSION, data, "float32")
+        datasets, _ = est.prepare(data)
+        coords = est._build_coordinates(
+            datasets, {}, {}, logical_rows=data.num_samples)
+        k32 = fused_static_key(coords, est.update_sequence, 2, set(),
+                               "float32")
+        k16 = fused_static_key(coords, est.update_sequence, 2, set(),
+                               "bfloat16")
+        assert k32 != k16
+        # aliases collapse — "bf16" and "bfloat16" must share a key
+        k16b = fused_static_key(coords, est.update_sequence, 2, set(),
+                                "bf16")
+        assert k16 == k16b
+
+
+class TestServingPrecision:
+    def _model(self, seed=0):
+        from photon_tpu.models.game import (
+            FixedEffectModel, GameModel, RandomEffectModel,
+        )
+        from photon_tpu.models.glm import (
+            Coefficients, GeneralizedLinearModel,
+        )
+
+        rng = np.random.default_rng(seed)
+        e, s, d = 30, 4, 6
+        return GameModel({
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(means=jnp.asarray(
+                        rng.normal(size=d).astype(np.float32))),
+                    TaskType.LOGISTIC_REGRESSION,
+                ), "g",
+            ),
+            "per-user": RandomEffectModel(
+                coefficients=jnp.asarray(
+                    rng.normal(size=(e, s)).astype(np.float32)),
+                random_effect_type="userId",
+                feature_shard_id="u",
+                task=TaskType.LOGISTIC_REGRESSION,
+                proj_all=np.tile(np.arange(s), (e, 1)).astype(np.int64),
+                entity_keys=tuple(str(i) for i in range(e)),
+            ),
+        })
+
+    def test_bf16_tables_score_close_to_f32(self):
+        from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+        from photon_tpu.serve.tables import CoefficientTables
+
+        model = self._model()
+        t32 = CoefficientTables.from_game_model(model)
+        t16 = CoefficientTables.from_game_model(model, "bfloat16")
+        assert str(
+            t16.random["per-user"].weights.dtype) == "bfloat16"
+        p32 = ScorePrograms(t32, ladder=ShapeLadder((4,)))
+        p16 = ScorePrograms(t16, ladder=ShapeLadder((4,)))
+        assert p16.dtype == np.float32  # request payloads stay f32
+        rng = np.random.default_rng(1)
+        reqs = [
+            ({"g": rng.normal(size=6).astype(np.float32),
+              "u": rng.normal(size=4).astype(np.float32)},
+             {"userId": str(i)})
+            for i in range(4)
+        ]
+        f32_scores = p32.score_padded(*p32.pack_requests(reqs)[:2], 4)
+        f16_scores = p16.score_padded(*p16.pack_requests(reqs)[:2], 4)
+        np.testing.assert_allclose(
+            f16_scores, f32_scores, atol=5e-2, rtol=5e-2)
+
+    def test_values_only_reload_preserves_precision_and_programs(self):
+        from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+        from photon_tpu.serve.tables import CoefficientTables
+        from photon_tpu.utils import compile_event_count
+
+        t16 = CoefficientTables.from_game_model(self._model(), "bf16")
+        programs = ScorePrograms(t16, ladder=ShapeLadder((1, 4)))
+        before = compile_event_count()
+        # An f32-trained refreshed model reloads into bf16 tables
+        # VALUES-ONLY: the candidate is built at the live precision.
+        assert t16.reload(self._model(seed=9)) is True
+        assert str(
+            t16.random["per-user"].weights.dtype) == "bfloat16"
+        rng = np.random.default_rng(2)
+        reqs = [
+            ({"g": rng.normal(size=6).astype(np.float32),
+              "u": rng.normal(size=4).astype(np.float32)},
+             {"userId": "3"})
+        ]
+        programs.score_padded(*programs.pack_requests(reqs)[:2], 1)
+        assert compile_event_count() - before == 0
+
+    def test_structure_key_separates_precisions(self):
+        from photon_tpu.serve.tables import CoefficientTables
+
+        t32 = CoefficientTables.from_game_model(self._model())
+        t16 = CoefficientTables.from_game_model(self._model(), "bf16")
+        assert t32.structure_key() != t16.structure_key()
+
+
+class TestBucketBatching:
+    def test_merge_off_by_default(self):
+        counts = np.asarray([3, 10, 10, 100, 2000])
+        active = np.ones(5, bool)
+        out = _assign_buckets(counts, active, (16, 64, 256, 1024, 4096))
+        assert sorted(out) == [16, 256, 4096]
+
+    def test_tail_buckets_merge_upward(self):
+        counts = np.asarray([3, 10, 10, 100, 2000])
+        active = np.ones(5, bool)
+        out = _assign_buckets(
+            counts, active, (16, 64, 256, 1024, 4096),
+            min_bucket_entities=4,
+        )
+        # the 16-cap tail (3 entities) rides into the 256 bucket, which
+        # then meets the floor (4); the largest bucket never merges.
+        assert sorted(out) == [256, 4096]
+        assert sorted(out[256].tolist()) == [0, 1, 2, 3]
+        # a floor above every intermediate bucket cascades all the way
+        out5 = _assign_buckets(
+            counts, active, (16, 64, 256, 1024, 4096),
+            min_bucket_entities=5,
+        )
+        assert sorted(out5) == [4096]
+        assert sorted(out5[4096].tolist()) == [0, 1, 2, 3, 4]
+
+    def test_merge_never_drops_and_respects_floor(self):
+        rng = np.random.default_rng(0)
+        counts = rng.integers(1, 5000, 200)
+        active = rng.uniform(size=200) < 0.8
+        base = _assign_buckets(counts, active, (16, 64, 256, 1024, 4096))
+        merged = _assign_buckets(
+            counts, active, (16, 64, 256, 1024, 4096),
+            min_bucket_entities=20,
+        )
+        all_base = np.sort(np.concatenate(list(base.values())))
+        all_merged = np.sort(np.concatenate(list(merged.values())))
+        np.testing.assert_array_equal(all_base, all_merged)
+        assert len(merged) <= len(base)
+        # every bucket except possibly the largest meets the floor
+        for cap in sorted(merged)[:-1]:
+            assert merged[cap].size >= 20
+        # members never exceed their bucket's row cap
+        for cap, ids in merged.items():
+            assert counts[ids].max(initial=0) <= cap
+
+    def test_estimator_parity_with_merging(self):
+        data = _workload(TaskType.LOGISTIC_REGRESSION)
+
+        def fit(min_bucket):
+            est = GameEstimator(
+                TaskType.LOGISTIC_REGRESSION,
+                {
+                    "global": FixedEffectCoordinateConfiguration(
+                        "g", _l2(1e-2)),
+                    "per-user": RandomEffectCoordinateConfiguration(
+                        RandomEffectDataConfiguration(
+                            "userId", "u",
+                            min_bucket_entities=min_bucket,
+                        ),
+                        _l2(1.0),
+                    ),
+                },
+                num_iterations=2,
+                mesh="off",
+            )
+            datasets, _ = est.prepare(data)
+            n_blocks = len(datasets["per-user"].blocks)
+            return est.fit(data)[0].model, n_blocks
+
+        m_base, blocks_base = fit(0)
+        m_merged, blocks_merged = fit(10_000)
+        assert blocks_merged <= blocks_base
+        assert blocks_merged == 1  # floor above every bucket: one slab
+        # same optimum (merging only widens padding; padded rows carry
+        # weight 0) — tight f32 tolerance, this is not a precision test
+        np.testing.assert_allclose(
+            np.asarray(m_merged.models["per-user"].coefficients),
+            np.asarray(m_base.models["per-user"].coefficients),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+class TestDonationSafety:
+    def test_warmup_thunks_run_with_donation(self):
+        # warmup_thunks used to pass w0_full as BOTH the warm-start and
+        # the donated output table — with donation live that is an XLA
+        # "donated buffer also an input" runtime error. The fix gives
+        # each thunk fresh tables; this runs the real thunks.
+        data = _workload(TaskType.LOGISTIC_REGRESSION)
+        est, _ = _fit(TaskType.LOGISTIC_REGRESSION, data, "float32")
+        datasets, _ = est.prepare(data)
+        coords = est._build_coordinates(
+            datasets, {}, {}, logical_rows=data.num_samples)
+        coord = coords["per-user"]
+        for thunk in coord.warmup_thunks():
+            thunk()
+
+    def test_unfused_train_rebinds_donated_tables(self):
+        # The unfused per-bucket loop donates w_all/v_all through
+        # _scatter_results; a second train() on the same coordinate must
+        # not touch deleted buffers.
+        data = _workload(TaskType.LOGISTIC_REGRESSION)
+        est, _ = _fit(TaskType.LOGISTIC_REGRESSION, data, "float32")
+        datasets, _ = est.prepare(data)
+        coords = est._build_coordinates(
+            datasets, {}, {}, logical_rows=data.num_samples)
+        coord = coords["per-user"]
+        m1, _ = coord.train()
+        m2, _ = coord.train(initial_model=m1)
+        np.asarray(m1.coefficients)  # still alive (never donated)
+        np.asarray(m2.coefficients)
+
+
+class TestSubAddDonation:
+    def test_aliased_carry_takes_plain_path(self):
+        from photon_tpu.algorithm.coordinate_descent import _sub_add
+
+        t = jnp.ones(16)
+        new = jnp.full(16, 2.0)
+        # total IS the stored score (single-coordinate descent): must
+        # not crash on aliased donation, and must compute correctly.
+        out = _sub_add(t, t, new)
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    def test_distinct_carry_donates_and_rebinds(self):
+        from photon_tpu.algorithm.coordinate_descent import _sub_add
+
+        t = jnp.ones(16)
+        old = jnp.full(16, 0.5)
+        new = jnp.full(16, 2.0)
+        out = _sub_add(t, old, new)
+        np.testing.assert_allclose(np.asarray(out), 2.5)
+        np.asarray(old), np.asarray(new)  # non-carry operands alive

@@ -1,0 +1,258 @@
+"""Exactness tests for the Pallas segment-reduce kernel (interpret mode).
+
+The kernel path runs FORCED through the interpreter on CPU
+(``PHOTON_SEGMENT_KERNEL=force`` + ``interpret_required()``), with the
+``.at[].add`` / ``segment_sum`` fallbacks as the parity oracle. Cases the
+scoring scatter actually produces: duplicate slots, empty segments,
+phantom-entity masks, out-of-bounds drop codes, straddling windows.
+
+Shapes here are deliberately odd-sized so the forced-kernel traces never
+collide in the jit cache with the default-path traces other tests make.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.ops import segment_reduce as sr
+
+
+@pytest.fixture
+def force_kernel(monkeypatch):
+    monkeypatch.setenv("PHOTON_SEGMENT_KERNEL", "force")
+
+
+def _ref_scatter(n, ids, vals):
+    out = np.zeros(n, np.float32)
+    ok = (ids >= 0) & (ids < n)
+    np.add.at(out, ids[ok], vals[ok])
+    return out
+
+
+class TestSortedSegmentSum:
+    def test_matches_scatter_with_duplicates(self, force_kernel):
+        rng = np.random.default_rng(0)
+        n = 2_531
+        reps = rng.integers(0, 4, n)
+        ids = np.repeat(np.arange(n), reps).astype(np.int32)
+        vals = rng.normal(size=ids.size).astype(np.float32)
+        out = sr.sorted_segment_sum(
+            jnp.asarray(vals), jnp.asarray(ids), n, multiplicity=3
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), _ref_scatter(n, ids, vals), rtol=1e-6,
+            atol=1e-5,
+        )
+
+    def test_empty_segments_stay_zero(self, force_kernel):
+        # Entities with no rows (the empty-entity case): ids skip whole
+        # ranges; those segments must come back exactly 0.
+        n = 4_099
+        ids = np.asarray([5, 5, 2_049, 4_098], np.int32)
+        vals = np.asarray([1.5, 2.5, -1.0, 4.0], np.float32)
+        out = np.asarray(sr.sorted_segment_sum(
+            jnp.asarray(vals), jnp.asarray(ids), n, multiplicity=2
+        ))
+        ref = _ref_scatter(n, ids, vals)
+        np.testing.assert_array_equal(out, ref)
+        assert out[0] == 0.0 and out[100] == 0.0
+
+    def test_out_of_bounds_codes_drop(self, force_kernel):
+        # id == num_segments is the drop marker (phantom/padding rows);
+        # anything at or past n contributes nowhere.
+        n = 1_283
+        ids = np.asarray([0, 1, n, n, n], np.int32)
+        vals = np.asarray([1.0, 2.0, 100.0, 100.0, 100.0], np.float32)
+        out = np.asarray(sr.sorted_segment_sum(
+            jnp.asarray(vals), jnp.asarray(ids), n, multiplicity=3
+        ))
+        assert out[0] == 1.0 and out[1] == 2.0
+        assert float(np.abs(out).sum()) == 3.0
+
+    def test_bf16_values_accumulate_f32(self, force_kernel):
+        # Many bf16 values into ONE segment: bf16 accumulation would
+        # stall once the partial sum outgrows the increment's precision
+        # (1024 + 1 == 1024 in bf16); the kernel must keep counting.
+        m = 4_096
+        ids = np.zeros(m, np.int32)
+        vals = jnp.ones(m, jnp.bfloat16)
+        out = np.asarray(sr.sorted_segment_sum(
+            vals, jnp.asarray(ids), 7, multiplicity=m
+        ))
+        assert out.dtype == np.float32
+        assert out[0] == float(m)
+
+    def test_fallback_matches_kernel(self, monkeypatch):
+        rng = np.random.default_rng(3)
+        n = 1_537
+        ids = np.sort(rng.integers(0, n, 900)).astype(np.int32)
+        # bound multiplicity by construction
+        ids = np.unique(ids)
+        vals = rng.normal(size=ids.size).astype(np.float32)
+        monkeypatch.setenv("PHOTON_SEGMENT_KERNEL", "off")
+        off = np.asarray(sr.sorted_segment_sum(
+            jnp.asarray(vals), jnp.asarray(ids), n))
+        monkeypatch.setenv("PHOTON_SEGMENT_KERNEL", "force")
+        on = np.asarray(sr.sorted_segment_sum(
+            jnp.asarray(vals), jnp.asarray(ids), n))
+        np.testing.assert_allclose(off, on, rtol=1e-6, atol=1e-6)
+
+
+class TestScatterAddRows:
+    def test_matches_at_add_with_phantom_mask(self, force_kernel):
+        # The bucket scorer's exact shape: [B, R] row ids, invalid lanes
+        # (beyond row_counts — phantom/padding rows aliasing row 0) must
+        # contribute NOTHING even though their slot values are garbage.
+        rng = np.random.default_rng(1)
+        b, r, n = 37, 29, 1_201
+        row_ids = rng.permutation(n)[: b * r].reshape(b, r).astype(
+            np.int32)
+        zb = rng.normal(size=(b, r)).astype(np.float32)
+        valid = rng.uniform(size=(b, r)) < 0.7
+        # garbage on invalid lanes, aliased to row 0 like real plans
+        row_ids = np.where(valid, row_ids, 0).astype(np.int32)
+        z = rng.normal(size=n).astype(np.float32)
+        out = np.asarray(sr.scatter_add_rows(
+            jnp.asarray(z), jnp.asarray(row_ids), jnp.asarray(zb),
+            jnp.asarray(valid),
+        ))
+        ref = z.copy()
+        np.add.at(ref, row_ids[valid], zb[valid])
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-5)
+
+    def test_all_invalid_bucket_adds_nothing(self, force_kernel):
+        # Mesh sentinel entities have row_counts == 0: every lane
+        # invalid, z must come back unchanged.
+        n = 1_411
+        z = np.arange(n, dtype=np.float32)
+        out = np.asarray(sr.scatter_add_rows(
+            jnp.asarray(z),
+            jnp.zeros((5, 7), jnp.int32),
+            jnp.full((5, 7), 99.0, jnp.float32),
+            jnp.zeros((5, 7), bool),
+        ))
+        np.testing.assert_array_equal(out, z)
+
+
+class TestDensifyEll:
+    def test_matches_per_entity_scatter_with_duplicate_slots(
+        self, force_kernel
+    ):
+        rng = np.random.default_rng(2)
+        b, r, k, s = 11, 13, 7, 151
+        xi = rng.integers(0, s, size=(b, r, k)).astype(np.int32)
+        xi[0, 0, :3] = 5  # duplicate slots must SUM (scatter-add parity)
+        xv = rng.normal(size=(b, r, k)).astype(np.float32)
+        out = sr.densify_ell_blocks(jnp.asarray(xi), jnp.asarray(xv), s)
+        assert out is not None
+        ref = np.zeros((b, r, s), np.float32)
+        for bb in range(b):
+            for rr in range(r):
+                np.add.at(ref[bb, rr], xi[bb, rr], xv[bb, rr])
+        np.testing.assert_allclose(
+            np.asarray(out), ref, rtol=1e-6, atol=1e-5)
+
+    def test_unsupported_returns_none(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_SEGMENT_KERNEL", "off")
+        out = sr.densify_ell_blocks(
+            jnp.zeros((2, 3, 4), jnp.int32),
+            jnp.zeros((2, 3, 4), jnp.float32), 200,
+        )
+        assert out is None
+
+
+class TestSupportGate:
+    def test_flag_off_disables(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_SEGMENT_KERNEL", "off")
+        assert not sr.kernel_supported(100, 100, jnp.float32)
+
+    def test_auto_is_backend_gated(self, monkeypatch):
+        monkeypatch.delenv("PHOTON_SEGMENT_KERNEL", raising=False)
+        expected = jax.default_backend() == "tpu"
+        assert sr.kernel_supported(100, 100, jnp.float32) is expected
+
+    def test_dtype_gate(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_SEGMENT_KERNEL", "force")
+        assert sr.kernel_supported(10, 10, jnp.float32)
+        assert sr.kernel_supported(10, 10, jnp.bfloat16)
+        assert not sr.kernel_supported(10, 10, jnp.int32)
+        assert not sr.kernel_supported(10, 10, jnp.float64)
+
+    def test_traced_sites_record_cost(self, force_kernel):
+        ids = jnp.asarray(np.arange(257, dtype=np.int32) % 1_543)
+        sr.sorted_segment_sum(
+            jnp.ones(257, jnp.float32), jnp.sort(ids), 1_543,
+            multiplicity=1, site="segment_reduce/test_site",
+        )
+        info = sr.traced_sites()["segment_reduce/test_site"]
+        assert info["num_values"] == 257
+        assert info["num_segments"] == 1_543
+        assert info["cost"]["hbm_bytes"] > 0
+
+    def test_oversized_multiplicity_falls_back(self, force_kernel):
+        # A multiplicity bound whose coverage window exceeds _MAX_K_TILES
+        # must take the exact fallback, not a truncated kernel window.
+        m, n = 300, 1_021
+        ids = np.zeros(m, np.int32)
+        out = np.asarray(sr.sorted_segment_sum(
+            jnp.ones(m, jnp.float32), jnp.asarray(ids), n,
+            multiplicity=m * 400,
+        ))
+        assert out[0] == float(m)
+
+
+class TestBucketScoreAddIntegration:
+    def test_bucket_score_add_kernel_matches_fallback(self, monkeypatch):
+        # The real integration point (models/game.py:_bucket_score_add):
+        # forced-kernel output must equal the .at[].add fallback bit-for
+        # tolerance on the same operands.
+        from photon_tpu.models.game import _bucket_score_add
+
+        rng = np.random.default_rng(4)
+        b, r, s, n = 23, 17, 5, 907
+        x_slab = rng.normal(size=(b, r, s)).astype(np.float32)
+        row_ids = rng.permutation(n)[: b * r].reshape(b, r).astype(
+            np.int32)
+        row_counts = rng.integers(0, r + 1, b).astype(np.int32)
+        codes = rng.integers(0, 31, b).astype(np.int32)
+        w = rng.normal(size=(31, s)).astype(np.float32)
+        z = np.zeros(n, np.float32)
+
+        def run():
+            return np.asarray(_bucket_score_add(
+                jnp.asarray(z), jnp.asarray(x_slab),
+                jnp.asarray(row_ids), jnp.asarray(row_counts),
+                jnp.asarray(codes), jnp.asarray(w),
+            ))
+
+        monkeypatch.setenv("PHOTON_SEGMENT_KERNEL", "off")
+        ref = run()
+        monkeypatch.setenv("PHOTON_SEGMENT_KERNEL", "force")
+        # distinct shape for the forced trace: clear the jit cache
+        # collision hazard by perturbing nothing — _bucket_score_add is
+        # jitted; same avals would reuse the fallback trace. Clear it.
+        _bucket_score_add._clear_cache()
+        out = run()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+        _bucket_score_add._clear_cache()
+
+
+def test_contract_gate():
+    """The segment-reduce PROGRAM_AUDIT passes through the real tier-2
+    machinery (census, recompile families, hot-loop checks)."""
+    from photon_tpu.analysis.program import (
+        contract_from_declaration,
+        run_checks,
+    )
+    from photon_tpu.ops.segment_reduce import PROGRAM_AUDIT
+
+    contract = contract_from_declaration(dict(PROGRAM_AUDIT))
+    findings = [
+        f for f in run_checks(contract, contract.build())
+        if not f.suppressed
+    ]
+    assert findings == [], [f.format() for f in findings]
