@@ -533,6 +533,55 @@ def predict_program_costs(est, datasets, per_fit_seconds, rows) -> dict:
         return {"error": repr(exc)}
 
 
+def predict_fused_fit_memory(est, datasets, rows) -> dict:
+    """Static HBM prediction for the fit's resident slab set, joined to
+    the ledger's measured booking for the SAME run.
+
+    Predicted: aval bytes of ``eval_shape`` over the slab-materialization
+    program (the exact call FusedFit.trace makes — no device, no
+    execution). Measured: the ``fused_fit/slabs`` resident row the fused
+    fit books when it lands the materialized slabs (obs/ledger.py). The
+    two must agree — this is the runtime half of the tier-4 memory
+    contract (analysis/memory.py), and the smoke/full gates hold the
+    ratio inside [1/1.5, 1.5]. Never fails the bench: ineligible paths
+    report why.
+    """
+    try:
+        import jax
+
+        from photon_tpu.analysis.memory import aval_nbytes
+        from photon_tpu.obs import ledger
+
+        cache = getattr(est, "_fused_cache", None)
+        if not cache:
+            return {"skipped": "no fused program (unfused/mesh path)"}
+        fused = next(reversed(cache.values()))
+        coords = est._build_coordinates(datasets, {}, {}, rows)
+        ebs_avals = jax.eval_shape(
+            fused._mat_fn, fused._mat_operands(coords)
+        )
+        predicted = float(
+            sum(
+                aval_nbytes(leaf)
+                for leaf in jax.tree_util.tree_leaves(ebs_avals)
+            )
+        )
+        measured = ledger.snapshot()["resident_bytes"].get(
+            "fused_fit/slabs"
+        )
+        out = {
+            "predicted_bytes": predicted,
+            "measured_bytes": measured,
+        }
+        if measured:
+            out["predicted_vs_measured"] = round(
+                predicted / measured, 3
+            )
+        return out
+    except Exception as exc:  # the bench must keep printing its line
+        return {"error": repr(exc)}
+
+
 def _fit_blocking(est, data):
     """One full fit, completion forced via on-device checksums.
 
@@ -672,8 +721,10 @@ def run_variant(task_name):
     hbm = estimate_hbm_bytes(result, datasets, task_name)
     cost_model = predict_program_costs(
         est, datasets, per_fit, data.num_samples)
+    memory = predict_fused_fit_memory(est, datasets, data.num_samples)
     return dict(
         cost_model=cost_model,
+        memory=memory,
         attribution=attribution,
         ingest_seconds=ingest_seconds,
         compile_seconds=compile_seconds,
@@ -766,6 +817,21 @@ def run_serving() -> dict:
     tables = CoefficientTables.from_game_model(
         model, precision=BENCH_PRECISION
     )
+    # Tier-4 admission join (analysis/memory.py): the oracle's predicted
+    # table residency (shapes only, no device) next to the ledger's
+    # measured `table/*` rows the build just booked — byte-for-byte the
+    # same accounting, gated in `regressions` via memory_regressions.
+    from photon_tpu.analysis.memory import predict_resident_bytes
+    from photon_tpu.obs import ledger
+
+    predicted_tables = predict_resident_bytes(
+        model, precision=BENCH_PRECISION
+    )["tables_total_bytes"]
+    measured_tables = sum(
+        v
+        for k, v in ledger.snapshot()["resident_bytes"].items()
+        if k.startswith("table/")
+    )
     t0 = time.perf_counter()
     programs = ScorePrograms(tables, ladder=ShapeLadder(SERVE_RUNGS))
     ladder_seconds = time.perf_counter() - t0
@@ -773,8 +839,6 @@ def run_serving() -> dict:
         tables, programs, N_SERVE_REQUESTS,
         cold_fraction=SERVE_COLD_FRACTION, seed=7,
     )
-    from photon_tpu.obs import ledger
-
     ledger_mark = ledger.mark()
     before = compile_event_count()
     with MicroBatchQueue(
@@ -835,6 +899,8 @@ def run_serving() -> dict:
         "serving_hot_entities": summary["hot_entities"],
         "serving_batches": summary["batches"],
         "serving_errors": summary["errors"],
+        "serving_predicted_hbm_bytes": predicted_tables,
+        "serving_measured_hbm_bytes": measured_tables,
         "serving_rungs": list(programs.ladder.rungs),
         "serving_max_linger_ms": SERVE_MAX_LINGER_MS,
         "serving_programs_compiled": programs.stats["programs_compiled"],
@@ -1510,6 +1576,55 @@ def resilience_regressions() -> list[str]:
     return out
 
 
+def hbm_prediction_join(variant: dict, serving: dict) -> dict:
+    """The admission-oracle acceptance join: tier-4 static HBM
+    predictions (analysis/memory.py) against the ledger's measured
+    resident bytes from the SAME run — the fused fit's slab set and the
+    serving tables. The tracked `*_peak_hbm_bytes` gauges are the
+    MEASURED values (benchtrend ratchets them); `predicted_vs_measured_
+    hbm` carries the ratios the regression gate holds inside
+    [1/1.5, 1.5]."""
+    out = {}
+    ratios = {}
+    mem = variant.get("memory") if isinstance(variant, dict) else None
+    mem = mem if isinstance(mem, dict) else {}
+    measured = mem.get("measured_bytes")
+    if measured:
+        out["fused_fit_peak_hbm_bytes"] = measured
+        if mem.get("predicted_vs_measured") is not None:
+            ratios["fused_fit"] = mem["predicted_vs_measured"]
+    s_meas = serving.get("serving_measured_hbm_bytes")
+    s_pred = serving.get("serving_predicted_hbm_bytes")
+    if s_meas:
+        out["serving_peak_hbm_bytes"] = s_meas
+        if s_pred:
+            ratios["serving"] = round(s_pred / s_meas, 3)
+    out["predicted_vs_measured_hbm"] = ratios
+    return out
+
+
+def memory_regressions(join: dict) -> list[str]:
+    """HBM-admission entries for the output's `regressions` list: both
+    joins must ENGAGE (a missing ratio means the oracle or the ledger
+    feed died) and both ratios must hold inside [1/1.5, 1.5] — outside,
+    the static admission answer has drifted from the measured watermark
+    and ROADMAP item 3's "will it fit" call can no longer be trusted."""
+    out = []
+    ratios = join.get("predicted_vs_measured_hbm") or {}
+    for name in ("fused_fit", "serving"):
+        ratio = ratios.get(name)
+        if ratio is None:
+            out.append(
+                f"{name} HBM join produced no predicted_vs_measured "
+                "ratio (admission oracle or ledger resident feed dead)")
+        elif not (1 / 1.5 <= ratio <= 1.5):
+            out.append(
+                f"predicted_vs_measured_hbm[{name}] {ratio:.2f} outside "
+                "[0.67, 1.5] (admission oracle drifted from the "
+                "measured watermark)")
+    return out
+
+
 def serving_regressions(serving: dict) -> list[str]:
     """Serving entries for the output's `regressions` list."""
     out = []
@@ -1853,6 +1968,10 @@ def _variant_fields(name: str, v: dict) -> dict:
         # residual. The fraction is ALSO surfaced top-level — it is a
         # benchtrend-tracked metric with a FLOORS gate, not just a
         # report field.
+        # Tier-4 admission join (analysis/memory.py): the statically
+        # predicted slab residency next to the ledger's measured
+        # booking — the ratio is gated in `regressions`.
+        f"{name}_memory": v["memory"],
         f"{name}_attribution": v["attribution"],
         f"{name}_attributed_fraction": v["attribution"].get(
             "attributed_fraction"),
@@ -1957,6 +2076,8 @@ def run_smoke(streaming: bool = False, pilot: bool = False,
     # serve spans/metrics land in the smoke output's telemetry too.
     serving = run_serving()
     regressions.extend(serving_regressions(serving))
+    hbm_join = hbm_prediction_join(lin, serving)
+    regressions.extend(memory_regressions(hbm_join))
     streaming_out = {}
     if streaming:
         streaming_out = run_streaming()
@@ -2007,6 +2128,7 @@ def run_smoke(streaming: bool = False, pilot: bool = False,
     }
     out.update(_variant_fields("linear", lin))
     out.update(serving)
+    out.update(hbm_join)
     out.update(streaming_out)
     out.update(pilot_out)
     out.update(drift_out)
@@ -2125,6 +2247,8 @@ def main(argv=None):
     regressions.extend(
         attribution_regressions("logistic", logi["attribution"]))
     regressions.extend(serving_regressions(serving))
+    regressions.extend(
+        memory_regressions(hbm_prediction_join(logi, serving)))
     regressions.extend(streaming_regressions(streaming))
     regressions.extend(pilot_regressions(pilot))
     regressions.extend(drift_regressions(drift))
@@ -2148,6 +2272,7 @@ def main(argv=None):
     for name, v in (("logistic", logi), ("linear", lin)):
         out.update(_variant_fields(name, v))
     out.update(serving)
+    out.update(hbm_prediction_join(logi, serving))
     out.update(streaming)
     out.update(pilot)
     out.update(drift)

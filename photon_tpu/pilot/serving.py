@@ -12,6 +12,31 @@ queue's quiesce window. Serving is never torn down for a promotion.
 
 from __future__ import annotations
 
+# Memory contract (audited by `python -m photon_tpu.analysis --memory`,
+# machinery in analysis/memory.py): the pilot serves through the same
+# ladder machinery as serve/programs, so its rungs carry the same
+# per-rung budget shape; what is pilot-specific is the PROMOTION path —
+# every promotion drives ``CoefficientTables.rebuild_from``, whose
+# structure-changing case holds two table generations resident until
+# the quiesced swap. That double-residency window is the declared
+# transient allowance here.
+MEMORY_AUDIT = dict(
+    name="pilot-serving-memory",
+    entry="pilot.serving.PilotServer (ladder + promotion reload)",
+    covers=("pilot",),
+    builder="build_pilot_serving_memory",
+    budgets={
+        "score_b*": (
+            "e * s * (wbytes + 4) + d * wbytes + 120 * wbytes"
+            " + rung * (d + du + 2 * s + 16) * wbytes"
+        ),
+    },
+    transients={
+        "promotion_rebuild": "2 * (d * wbytes + e * s * (wbytes + 4))",
+    },
+    tolerance=1.5,
+)
+
 
 class PilotServer:
     """Live scorer the pilot promotes into. Thin by design: all the

@@ -31,6 +31,30 @@ import numpy as np
 
 from photon_tpu.serve.tables import CoefficientTables
 
+# Memory contract (audited by `python -m photon_tpu.analysis --memory`,
+# machinery in analysis/memory.py): the expected peak-HBM of a score
+# rung as a formula over the audit fixture's dims. One formula covers
+# every rung (the `score_b*` pattern): the resident tables (weights at
+# storage width + int32 projector) plus a fixed program scaffold, plus
+# a per-row live set — the padded feature payloads, gathered per-row
+# coefficients, row codes, and partial scores. The reload path's
+# donating swap (tables._swap_values) must alias in compiled HLO or a
+# structure reload holds both table generations resident.
+MEMORY_AUDIT = dict(
+    name="serving-memory",
+    entry="serve.programs.ScorePrograms (score ladder rungs)",
+    covers=("serving",),
+    builder="build_serving_memory",
+    budgets={
+        "score_b*": (
+            "e * s * (wbytes + 4) + d * wbytes + 120 * wbytes"
+            " + rung * (d + du + 2 * s + 16) * wbytes"
+        ),
+    },
+    donations={"serve.tables._swap_values": (0,)},
+    tolerance=1.5,
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class ShapeLadder:

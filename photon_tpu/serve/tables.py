@@ -41,7 +41,45 @@ from photon_tpu.models.game import (
 )
 from photon_tpu.types import TaskType, make_feature_key
 
+# Memory contract (audited by `python -m photon_tpu.analysis --memory`,
+# machinery in analysis/memory.py): byte-exact resident formulas for the
+# built tables — a fixed coordinate is its [d] weight vector at storage
+# width, a random coordinate its [E,S] weights at storage width plus the
+# [E,S] int32 projector (the projector never narrows under bf16) — each
+# priced against the BUILT device arrays at f32 AND bf16 and against the
+# admission oracle (analysis/memory.predict_resident_bytes). A
+# structure-changing ``rebuild_from`` builds the next generation
+# off-path while the old one serves, so its double-residency window is a
+# declared transient allowance, not an accident.
+MEMORY_AUDIT = dict(
+    name="tables-memory",
+    entry="serve.tables.CoefficientTables",
+    builder="build_tables_memory",
+    resident={
+        "table/global": "d * wbytes",
+        "table/per-user": "e * s * (wbytes + 4)",
+    },
+    transients={
+        "rebuild_from": "2 * (d * wbytes + e * s * (wbytes + 4))",
+    },
+    donations={"serve.tables._swap_values": (0,)},
+    tolerance=1.5,
+)
+
 _swap_cache: dict[tuple, object] = {}
+
+
+def _swap_values(prev, new):
+    """The donating swap body: select the new values INTO the old
+    buffer. The select (rather than returning ``new`` outright) keeps
+    ``prev`` in the dataflow so the donation can alias the output into
+    its buffer — with an identity body jax finds no output to alias the
+    donated operand to and drops the donation silently, leaving both
+    generations resident (the exact failure analysis/memory.py's
+    donation audit exists to catch; it probes THIS function)."""
+    import jax.numpy as jnp
+
+    return jnp.where(True, new, prev)
 
 
 def _device_swap(old, new_host: np.ndarray):
@@ -61,7 +99,7 @@ def _device_swap(old, new_host: np.ndarray):
     fn = _swap_cache.get(key)
     if fn is None:
         donate = (0,) if jax.default_backend() not in ("cpu",) else ()
-        fn = jax.jit(lambda prev, new: new, donate_argnums=donate)
+        fn = jax.jit(_swap_values, donate_argnums=donate)
         _swap_cache[key] = fn
     return fn(old, new_host)
 

@@ -1,0 +1,86 @@
+"""Fixture: use-after-donate — reads of a binding after it was donated.
+
+Covers the three donating-callable shapes the rule recognizes (direct
+``jax.jit(..., donate_argnums=...)`` assignment, a
+``functools.partial(jax.jit, ...)`` decorated def, and the one-hop
+dispatcher that forwards its own parameter to a donating callable), the
+legal suppressed re-bind, and clean variants (rebind kills the taint;
+non-literal donate_argnums is skipped by design).
+"""
+
+import functools
+
+import jax
+
+_swap_donating = jax.jit(lambda old, new: new, donate_argnums=(0,))
+_swap_plain = jax.jit(lambda old, new: new)
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _accumulate(update, carry):
+    return carry + update
+
+
+def _dispatch(total, new):
+    # One-hop propagation: forwarding `total` to a donating callable at
+    # a donated position makes this dispatcher donate position 0.
+    if total is new:
+        return _swap_plain(total, new)
+    return _swap_donating(total, new)
+
+
+def read_after_direct_donation(old, new):
+    out = _swap_donating(old, new)
+    return out + old  # EXPECT: use-after-donate
+
+
+def read_after_decorated_donation(update, carry):
+    out = _accumulate(update, carry)
+    checksum = carry.sum()  # EXPECT: use-after-donate
+    return out, checksum
+
+
+def read_after_dispatcher_donation(total, new):
+    out = _dispatch(total, new)
+    return out + total  # EXPECT: use-after-donate
+
+
+def later_read_without_rebind(old, new):
+    out = _swap_donating(old, new)
+    extra = old * 2  # EXPECT: use-after-donate
+    return out + extra
+
+
+def suppressed_rebind_read(old, new):
+    # The call re-binds `old` in the same statement, so the read below
+    # sees the new buffer — the coordinate_descent.py carry pattern.
+    old = _swap_donating(old, new)
+    return old + 1  # photon: ignore[use-after-donate] -- the call re-binds `old` to its result in the same statement; this reads the new buffer
+
+
+def clean_rebind_kills_taint(old, new, fresh):
+    out = _swap_donating(old, new)
+    old = fresh
+    return out + old
+
+
+def clean_no_read_after(old, new):
+    return _swap_donating(old, new)
+
+
+def clean_plain_twin(old, new):
+    out = _swap_plain(old, new)
+    return out + old
+
+
+def _gated_swap(old, new):
+    # Non-literal donate_argnums (the serve-tables CPU gate): skipped —
+    # a computed tuple cannot be checked flow-insensitively.
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    fn = jax.jit(lambda prev, nxt: nxt, donate_argnums=donate)
+    return fn(old, new)
+
+
+def clean_gated_swap(old, new):
+    out = _gated_swap(old, new)
+    return out + old

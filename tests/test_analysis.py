@@ -45,6 +45,7 @@ REQUIRED_RULES = frozenset(
         "int32-overflow",
         "debug-debris",
         "bf16-accumulation",
+        "use-after-donate",
     }
 )
 

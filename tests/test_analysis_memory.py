@@ -1,0 +1,429 @@
+"""photon_tpu.analysis tier 4: the memory auditor.
+
+Layout mirrors the tier-2/tier-3 test files:
+- unit tests pin the static walk's live-range semantics (donation
+  retirement, sub-jaxpr spikes) on hand-built programs with known peaks;
+- one violating fixture per check proves each rule produces EXACTLY its
+  finding: an undeclared slab (memory-undeclared-growth), a rotten
+  formula (memory-stale-formula), a silently-dropped donation
+  (memory-dropped-donation), and coverage/oracle drift (memory-contract);
+- the admission oracle is pinned byte-for-byte against the ledger's
+  measured residency for BUILT tables at f32 AND bf16 — the static and
+  measured halves of the admission answer must agree exactly;
+- the gate: ``python -m photon_tpu.analysis --memory`` exits 0 over the
+  repo's declared contracts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from photon_tpu.analysis import memory as M  # noqa: E402
+from photon_tpu.analysis.__main__ import main as cli_main  # noqa: E402
+
+
+def _contract(**kw) -> M.MemoryContract:
+    base = dict(
+        name="t", entry="tests", build=M.MemoryTrace, tolerance=1.5
+    )
+    base.update(kw)
+    return M.MemoryContract(**base)
+
+
+def _rules(findings) -> list[str]:
+    return sorted(f.rule for f in findings if not f.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# the static walk
+# ---------------------------------------------------------------------------
+
+
+def test_static_peak_simple_chain():
+    # f(x) = (x + 1) * 2 over [1024] f32: input (4096 B) lives whole
+    # program, two intermediates of 4096 B each with disjoint-by-one
+    # overlap — peak is input + both temps at the multiply step.
+    def f(x):
+        return (x + 1.0) * 2.0
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((1024,), jnp.float32))
+    peak = M.static_peak_bytes(jaxpr)
+    assert peak == 3 * 4096
+
+
+def test_static_peak_donation_retires_input():
+    # Donated input retires after its only use; non-donated stays live
+    # to the end. Same program, two masks, strictly smaller peak.
+    def f(x):
+        y = x * 2.0
+        return y + 1.0
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((1024,), jnp.float32))
+    plain = M.static_peak_bytes(jaxpr, donated=[False])
+    donated = M.static_peak_bytes(jaxpr, donated=[True])
+    assert donated < plain
+    # donated: x retires after eqn 0 -> peak is {x, y} = 2 buffers
+    assert donated == 2 * 4096
+    assert plain == 3 * 4096
+
+
+def test_static_peak_counts_scan_body_spike():
+    # A scan whose body materializes a large temp: the body's internal
+    # peak beyond its boundary must surface as a transient spike.
+    def body(carry, _):
+        big = jnp.outer(carry, carry)  # [256, 256] = 256 KiB temp
+        return carry + big.sum(axis=1), ()
+
+    def f(x):
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return out
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((256,), jnp.float32))
+    peak = M.static_peak_bytes(jaxpr)
+    assert peak >= 256 * 256 * 4  # the body's outer-product temp
+
+
+def test_aval_nbytes_and_boundary():
+    aval = jax.ShapeDtypeStruct((8, 4), jnp.bfloat16)
+    assert M.aval_nbytes(aval) == 8 * 4 * 2
+    jaxpr = jax.make_jaxpr(lambda x: x + 1.0)(
+        jnp.zeros((16,), jnp.float32)
+    )
+    assert M._jaxpr_boundary_bytes(jaxpr) == 2 * 64
+
+
+# ---------------------------------------------------------------------------
+# violating fixtures: one per check, exactly its finding
+# ---------------------------------------------------------------------------
+
+
+def _traced_program(name: str, fn, *avals, dims=None) -> M.ProgramMemory:
+    traced = jax.jit(fn).trace(*avals)
+    return M.ProgramMemory(
+        name=name,
+        jaxpr=traced.jaxpr,
+        lowered=traced.lower(),
+        dims=dict(dims or {}),
+    )
+
+
+def test_undeclared_growth_fixture():
+    # The program materializes an [n, n] slab the formula does not
+    # price: exactly one memory-undeclared-growth.
+    def slabby(x):
+        return jnp.outer(x, x).sum(axis=1)
+
+    n = 512
+    prog = _traced_program(
+        "slabby", slabby, jax.ShapeDtypeStruct((n,), jnp.float32)
+    )
+    trace = M.MemoryTrace(
+        programs={"slabby": prog}, dims={"n": float(n), "wbytes": 4.0}
+    )
+    contract = _contract(budgets={"slabby": "3 * n * wbytes"})
+    findings = M.run_checks(contract, trace)
+    assert _rules(findings) == ["memory-undeclared-growth"]
+    assert "slabby" in findings[0].message
+
+
+def test_stale_formula_fixture():
+    # The formula prices a slab the program no longer allocates:
+    # exactly one memory-stale-formula.
+    def lean(x):
+        return x * 2.0
+
+    n = 512
+    prog = _traced_program(
+        "lean", lean, jax.ShapeDtypeStruct((n,), jnp.float32)
+    )
+    trace = M.MemoryTrace(
+        programs={"lean": prog}, dims={"n": float(n), "wbytes": 4.0}
+    )
+    contract = _contract(budgets={"lean": "n * n * wbytes"})
+    findings = M.run_checks(contract, trace)
+    assert _rules(findings) == ["memory-stale-formula"]
+
+
+def test_broken_formula_is_stale_formula():
+    prog = _traced_program(
+        "p", lambda x: x, jax.ShapeDtypeStruct((4,), jnp.float32)
+    )
+    trace = M.MemoryTrace(programs={"p": prog}, dims={})
+    contract = _contract(budgets={"p": "no_such_dim * 4"})
+    findings = M.run_checks(contract, trace)
+    assert _rules(findings) == ["memory-stale-formula"]
+    assert "no longer evaluates" in findings[0].message
+
+
+def test_missing_budget_is_contract_finding():
+    prog = _traced_program(
+        "p", lambda x: x, jax.ShapeDtypeStruct((4,), jnp.float32)
+    )
+    trace = M.MemoryTrace(programs={"p": prog}, dims={})
+    findings = M.run_checks(_contract(), trace)
+    assert _rules(findings) == ["memory-contract"]
+    assert "no declared budget" in findings[0].message
+
+
+def test_dropped_donation_fixture():
+    # The deliberately-broken swap: a pure identity body gives jax no
+    # output to alias the donated operand into, so the donation is
+    # dropped SILENTLY — exactly one memory-dropped-donation naming the
+    # operand position.
+    sds = jax.ShapeDtypeStruct((7, 3), jnp.float32)
+    broken = jax.jit(
+        lambda prev, new: new, donate_argnums=(0,)
+    ).trace(sds, sds).lower()
+    trace = M.MemoryTrace(
+        donation_probes=[
+            M.DonationProbe(
+                name="broken_swap", lowered=broken, declared=(0,)
+            )
+        ]
+    )
+    findings = M.run_checks(_contract(), trace)
+    assert _rules(findings) == ["memory-dropped-donation"]
+    assert "broken_swap" in findings[0].message
+    assert "(0,)" in findings[0].message
+
+
+def test_live_donation_passes():
+    # The PRODUCTION swap body must alias — this is the regression test
+    # for serve/tables._swap_values (an identity body here fails).
+    from photon_tpu.serve.tables import _swap_values
+
+    sds = jax.ShapeDtypeStruct((7, 3), jnp.float32)
+    ok = jax.jit(_swap_values, donate_argnums=(0,)).trace(
+        sds, sds
+    ).lower()
+    trace = M.MemoryTrace(
+        donation_probes=[
+            M.DonationProbe(
+                name="serve.tables._swap_values",
+                lowered=ok,
+                declared=(0,),
+            )
+        ]
+    )
+    assert M.run_checks(_contract(), trace) == []
+
+
+def test_donation_count_drift_is_a_finding():
+    # Declaration says two donated operands, trace marks one: the
+    # donate_argnums drifted from the declared map.
+    from photon_tpu.serve.tables import _swap_values
+
+    sds = jax.ShapeDtypeStruct((4,), jnp.float32)
+    one = jax.jit(_swap_values, donate_argnums=(0,)).trace(
+        sds, sds
+    ).lower()
+    trace = M.MemoryTrace(
+        donation_probes=[
+            M.DonationProbe(name="drifty", lowered=one, declared=(0, 1))
+        ]
+    )
+    findings = M.run_checks(_contract(), trace)
+    assert _rules(findings) == ["memory-dropped-donation"]
+    assert "drifted" in findings[0].message
+
+
+def test_transient_over_allowance_is_growth():
+    contract = _contract(transients={"rebuild": "2 * total"})
+    trace = M.MemoryTrace(
+        dims={"total": 100.0}, transient_values={"rebuild": 400.0}
+    )
+    findings = M.run_checks(contract, trace)
+    assert _rules(findings) == ["memory-undeclared-growth"]
+
+
+def test_oracle_drift_is_contract_finding():
+    contract = _contract(resident={"table/x": "n"})
+    trace = M.MemoryTrace(
+        dims={"n": 64.0},
+        residents=[
+            M.ResidentProbe(
+                precision="float32",
+                dims={},
+                measured={"table/x": 64.0},
+                predicted={"table/x": 60.0},  # oracle disagrees
+            )
+        ],
+    )
+    findings = M.run_checks(contract, trace)
+    assert _rules(findings) == ["memory-contract"]
+    assert "oracle" in findings[0].message
+
+
+def test_suppression_applies_with_reason():
+    prog = _traced_program(
+        "p", lambda x: x, jax.ShapeDtypeStruct((4,), jnp.float32)
+    )
+    trace = M.MemoryTrace(programs={"p": prog}, dims={})
+    contract = _contract(
+        suppress={"memory-contract": "budget lands next PR"}
+    )
+    findings = M.run_checks(contract, trace)
+    assert len(findings) == 1 and findings[0].suppressed
+    assert findings[0].suppress_reason == "budget lands next PR"
+
+
+# ---------------------------------------------------------------------------
+# the admission oracle vs the ledger's measured residency
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("precision", ["float32", "bfloat16"])
+def test_oracle_matches_ledger_resident_bytes(precision):
+    # predict_resident_bytes (static, shapes only) must agree
+    # BYTE-FOR-BYTE with what the ledger measures for the BUILT tables —
+    # same owner keys, same numbers, both precisions.
+    from photon_tpu.obs import ledger
+    from photon_tpu.serve.tables import CoefficientTables
+
+    model = M._tiny_game_model(
+        5, 7, 3, 6, proj_seed=1234, rng_seed=20260803
+    )
+    predicted = M.predict_resident_bytes(model, precision=precision)
+    ledger.enable()
+    ledger.reset()
+    try:
+        tables = CoefficientTables.from_game_model(model, precision)
+        snap = ledger.snapshot()
+    finally:
+        ledger.disable()
+        ledger.reset()
+    measured = {
+        k: v
+        for k, v in snap["resident_bytes"].items()
+        if k.startswith("table/")
+    }
+    assert set(measured) == set(predicted["tables"])
+    for owner, nbytes in measured.items():
+        assert int(predicted["tables"][owner]) == int(nbytes), owner
+    assert int(predicted["tables_total_bytes"]) == int(
+        sum(measured.values())
+    )
+    # and the builder's measured view agrees with the ledger's
+    assert {
+        k: int(v) for k, v in M._measured_table_bytes(tables).items()
+    } == {k: int(v) for k, v in measured.items()}
+
+
+def test_oracle_ladder_terms():
+    from photon_tpu.serve.programs import ShapeLadder
+
+    model = M._tiny_game_model(
+        5, 7, 3, 6, proj_seed=1234, rng_seed=20260803
+    )
+    out = M.predict_resident_bytes(model, ladder=ShapeLadder((1, 8)))
+    assert set(out["per_rung_request_bytes"]) == {1, 8}
+    # request bytes scale linearly in the rung
+    assert (
+        out["per_rung_request_bytes"][8]
+        == 8 * out["per_rung_request_bytes"][1]
+    )
+    assert (
+        out["peak_bytes"]
+        == out["tables_total_bytes"]
+        + out["per_rung_request_bytes"][8]
+    )
+    assert out["rebuild_peak_bytes"] == 2 * out["tables_total_bytes"]
+
+
+def test_oracle_bf16_narrows_weights_not_projector():
+    model = M._tiny_game_model(
+        5, 7, 3, 6, proj_seed=1234, rng_seed=20260803
+    )
+    f32 = M.predict_resident_bytes(model, precision="float32")
+    bf16 = M.predict_resident_bytes(model, precision="bfloat16")
+    # fixed: halves; random: weights halve, int32 projector does not
+    assert bf16["tables"]["table/global"] * 2 == (
+        f32["tables"]["table/global"]
+    )
+    e, s = 7, 3
+    assert f32["tables"]["table/per-user"] == e * s * 8
+    assert bf16["tables"]["table/per-user"] == e * s * 6
+
+
+# ---------------------------------------------------------------------------
+# coverage: every tier-2 entry point budgeted or waived
+# ---------------------------------------------------------------------------
+
+
+def test_coverage_clean_on_repo_declarations():
+    contracts = M.collect_contracts()
+    assert M.check_coverage(contracts) == []
+
+
+def test_uncovered_tier2_contract_is_a_finding():
+    contracts = [
+        c for c in M.collect_contracts() if c.name != "fused-fit-memory"
+    ]
+    findings = M.check_coverage(contracts)
+    assert _rules(findings) == ["memory-contract"]
+    assert "fused-fit" in findings[0].message
+
+
+def test_stale_waiver_is_a_finding(monkeypatch):
+    monkeypatch.setitem(M.TIER2_WAIVERS, "no-such-contract", "stale")
+    findings = M.check_coverage(M.collect_contracts())
+    assert _rules(findings) == ["memory-contract"]
+    assert "stale waiver" in findings[0].message
+
+
+def test_unknown_builder_raises():
+    with pytest.raises(ValueError, match="unknown"):
+        M.contract_from_declaration(
+            {"name": "x", "entry": "x", "builder": "no_such_builder"}
+        )
+
+
+# ---------------------------------------------------------------------------
+# CLI + the repo gate
+# ---------------------------------------------------------------------------
+
+
+def test_cli_memory_rejects_paths_and_select(capsys):
+    assert cli_main(["--memory", "photon_tpu"]) == 2
+    capsys.readouterr()
+    assert cli_main(["--memory", "--select", "use-after-donate"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_memory_excludes_other_tiers(capsys):
+    assert cli_main(["--memory", "--semantic"]) == 2
+    capsys.readouterr()
+    assert cli_main(["--memory", "--concurrency"]) == 2
+    capsys.readouterr()
+
+
+def test_repo_gate_memory_audit_clean(capsys):
+    # THE GATE: the declared MEMORY_AUDIT contracts hold over the repo.
+    assert cli_main(["--memory"]) == 0
+    out = capsys.readouterr().out
+    for cname in (
+        "fused-fit-memory",
+        "serving-memory",
+        "tables-memory",
+        "pilot-serving-memory",
+    ):
+        assert f"contract {cname}" in out
+    # the donation audit ran against compiled HLO
+    assert "aliased=1" in out
+
+
+def test_repo_audit_reports_static_peaks():
+    findings, report = M.audit(with_xla=False)
+    assert [f for f in findings if not f.suppressed] == []
+    fused = report["contracts"]["fused-fit-memory"]["programs"]
+    assert set(fused) == {"materialize", "fit", "fit_warm"}
+    for entry in fused.values():
+        assert entry["static_peak_bytes"] > 0
+        assert entry["budget_bytes"] > 0
+    # every serving rung priced
+    serving = report["contracts"]["serving-memory"]["programs"]
+    assert {"score_b1", "score_b8", "score_b64"} <= set(serving)
