@@ -966,6 +966,115 @@ def run_kernel_micro() -> dict:
     }
 
 
+def run_parity() -> dict:
+    """The `parity` scenario: per-family bf16-vs-f32 coefficient gap.
+
+    Fits each GLM family twice through the fused path — f32 reference
+    and bf16 policy — on a small fixed workload (the
+    tests/test_precision.py shape) and reports the max relative
+    coefficient error as ``parity_gap_{family}``. The FIXED per-family
+    ceilings live in tests/test_precision.py / PERFORMANCE.md; these
+    gauges feed benchtrend so a gap that quietly WIDENS (a new cast, a
+    changed solver route) fails the trend gate long before it climbs to
+    the fixed tolerance. Full bench only — two fits per family is waste
+    at smoke scale, and the tier-5 numerics audit plus the kernel-smoke
+    parity tests gate the policy in CI."""
+    from photon_tpu.algorithm.problems import GLMOptimizationConfiguration
+    from photon_tpu.data.dataset import DenseFeatures
+    from photon_tpu.data.game_data import make_game_dataset
+    from photon_tpu.data.random_effect import (
+        RandomEffectDataConfiguration,
+    )
+    from photon_tpu.estimators.game_estimator import (
+        FixedEffectCoordinateConfiguration,
+        GameEstimator,
+        RandomEffectCoordinateConfiguration,
+    )
+    from photon_tpu import optim
+    from photon_tpu.types import TaskType
+
+    def l2(w):
+        return GLMOptimizationConfiguration(
+            regularization=optim.RegularizationContext(
+                optim.RegularizationType.L2
+            ),
+            regularization_weight=w,
+        )
+
+    def workload(task):
+        rng = np.random.default_rng(20260806)
+        n, d, du, users = 3_000, 8, 5, 40
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        x[:, -1] = 1.0
+        xu = rng.normal(size=(n, du)).astype(np.float32)
+        xu[:, -1] = 1.0
+        uid = rng.integers(0, users, n)
+        w = 0.3 * rng.normal(size=d).astype(np.float32)
+        wu = 0.3 * rng.normal(size=(users, du)).astype(np.float32)
+        z = x @ w + np.einsum("nd,nd->n", xu, wu[uid])
+        if task == TaskType.LOGISTIC_REGRESSION:
+            y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(
+                np.float32)
+        elif task == TaskType.POISSON_REGRESSION:
+            y = rng.poisson(np.exp(np.clip(0.3 * z, -3, 3))).astype(
+                np.float32)
+        elif task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+            y = (z > 0).astype(np.float32)
+        else:
+            y = (z + 0.2 * rng.normal(size=n)).astype(np.float32)
+        return make_game_dataset(
+            y, {"g": DenseFeatures(x), "u": DenseFeatures(xu)},
+            id_tags={"userId": uid},
+        )
+
+    def fit(task, data, precision):
+        est = GameEstimator(
+            task,
+            {
+                "global": FixedEffectCoordinateConfiguration(
+                    "g", l2(1e-2)),
+                "per-user": RandomEffectCoordinateConfiguration(
+                    RandomEffectDataConfiguration("userId", "u"),
+                    l2(1.0),
+                ),
+            },
+            num_iterations=2,
+            mesh="off",
+            precision=precision,
+        )
+        return est.fit(data)[0].model
+
+    def rel_err(a, b):
+        a = np.asarray(a, np.float64)
+        b = np.asarray(b, np.float64)
+        scale = max(float(np.abs(b).max()), 1e-9)
+        return float(np.abs(a - b).max()) / scale
+
+    families = {
+        "linear": TaskType.LINEAR_REGRESSION,
+        "logistic": TaskType.LOGISTIC_REGRESSION,
+        "poisson": TaskType.POISSON_REGRESSION,
+        "smoothed_hinge": TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+    }
+    out = {}
+    for fam, task in families.items():
+        data = workload(task)
+        m32 = fit(task, data, "float32")
+        m16 = fit(task, data, "bfloat16")
+        gap = max(
+            rel_err(
+                m16.models["global"].model.coefficients.means,
+                m32.models["global"].model.coefficients.means,
+            ),
+            rel_err(
+                m16.models["per-user"].coefficients,
+                m32.models["per-user"].coefficients,
+            ),
+        )
+        out[f"parity_gap_{fam}"] = round(gap, 6)
+    return out
+
+
 def _write_stream_shards(shard_dir: str) -> None:
     """STREAM_ROWS synthetic TrainingExampleAvro rows across
     STREAM_SHARDS part files (sparse power-law-ish features + a userId
@@ -2223,6 +2332,7 @@ def main(argv=None):
     pilot = run_pilot()
     drift = run_drift()
     kernel_micro = run_kernel_micro()
+    parity = run_parity()
     sklearn_anchor = run_sklearn_baseline(logi["train_seconds"])
     yahoo = run_yahoo_music()
     a9a = run_a1a_logistic()
@@ -2277,6 +2387,7 @@ def main(argv=None):
     out.update(pilot)
     out.update(drift)
     out.update(kernel_micro)
+    out.update(parity)
     out.update(sklearn_anchor)
     out.update(yahoo)
     out.update(a9a)

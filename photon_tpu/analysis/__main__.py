@@ -1,6 +1,6 @@
 """CLI: ``python -m photon_tpu.analysis [paths...]``.
 
-Four tiers share this entry point:
+Five tiers share this entry point:
 
 - default: the tier-1 pure-``ast`` lint pass over source files;
 - ``--semantic``: the tier-2 program auditor (analysis/program.py) —
@@ -16,6 +16,12 @@ Four tiers share this entry point:
   donation-safety verification against compiled HLO, and the declared
   ``MEMORY_AUDIT`` budget contracts. Needs JAX (CPU is fine; no device
   execution).
+- ``--numerics``: the tier-5 numerics auditor (analysis/numerics.py) —
+  dtype-provenance verification of the mixed-precision policy over the
+  traced jaxprs (bf16 lineage must accumulate f32), the cast census,
+  static worst-case error budgets, and the reduction-determinism
+  census, against the declared ``NUMERICS_AUDIT`` contracts. Needs JAX
+  (CPU is fine; no device execution).
 
 Exit codes: 0 clean (or only suppressed findings), 1 unsuppressed
 findings, 2 usage error.
@@ -93,6 +99,13 @@ def main(argv: list[str] | None = None) -> int:
         "the source lint",
     )
     parser.add_argument(
+        "--numerics",
+        action="store_true",
+        help="run the tier-5 numerics auditor (dtype-flow lattice, "
+        "cast census, static error budgets, determinism census, "
+        "NUMERICS_AUDIT contracts) instead of the source lint",
+    )
+    parser.add_argument(
         "--cost-out",
         metavar="PATH",
         help="with --semantic: also write the per-program cost-model/"
@@ -109,16 +122,27 @@ def main(argv: list[str] | None = None) -> int:
             print(render_rule_list())
         return 0
 
-    if sum((args.semantic, args.concurrency, args.memory)) > 1:
+    if sum(
+        (args.semantic, args.concurrency, args.memory, args.numerics)
+    ) > 1:
         print(
-            "--semantic, --concurrency, and --memory are separate "
-            "tiers; run them as separate invocations",
+            "--semantic, --concurrency, --memory, and --numerics are "
+            "separate tiers; run them as separate invocations",
             file=sys.stderr,
         )
         return 2
     if args.cost_out and not args.semantic:
         print("--cost-out requires --semantic", file=sys.stderr)
         return 2
+    if args.numerics:
+        if args.paths or args.select:
+            print(
+                "--numerics audits the package's declared numerics "
+                "contracts; paths/--select do not apply",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_numerics(args)
     if args.memory:
         if args.paths or args.select:
             print(
@@ -248,6 +272,36 @@ def _run_memory(args) -> int:
                     f"  donation {dname}: declared={d['declared']} "
                     f"aliased={d['aliased']}"
                 )
+            for note in entry["notes"]:
+                print(f"  note: {note}")
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+def _run_numerics(args) -> int:
+    from photon_tpu.analysis import numerics
+
+    findings, report = numerics.audit()
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_json() for f in findings],
+                    "report": report,
+                },
+                indent=2,
+            )
+        )
+    else:
+        out = render_text(findings, show_suppressed=args.show_suppressed)
+        if out:
+            print(out)
+        for cname, entry in report["contracts"].items():
+            progs = ", ".join(
+                f"{n}(rounds={p['rounds']}, "
+                f"len={int(p['reduce_len'])})"
+                for n, p in entry["programs"].items()
+            )
+            print(f"contract {cname}: {progs or 'no traced programs'}")
             for note in entry["notes"]:
                 print(f"  note: {note}")
     return 1 if any(not f.suppressed for f in findings) else 0

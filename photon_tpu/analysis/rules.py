@@ -311,14 +311,24 @@ def int32_overflow(ctx: ModuleContext) -> Iterator[Finding]:
 # --------------------------------------------------------------------------
 
 # Reductions whose accumulator silently inherits a bf16 operand dtype.
-# ops/segment_reduce.sorted_segment_sum is deliberately absent: its
-# kernel accumulates f32 internally.
+# Applies to EVERY analyzed module — the fused-fit modules where the
+# policy began, `serve/` (bf16 coefficient tables score under the same
+# f32-accumulator invariant), and `ops/segment_reduce.py`'s fallback
+# path alike; tier 5 (`--numerics`, NUMERICS_AUDIT) is the semantic
+# form of this rule and proves on jaxprs where the accumulator is
+# already f32 — those sites carry reasoned suppressions instead of
+# rewrites. ops/segment_reduce.sorted_segment_sum itself is
+# deliberately absent from the call set: its kernel accumulates f32
+# internally (verified per trace by the tier-5 contract).
 _BF16_REDUCE_PATHS = frozenset(
     {
         "jax.numpy.sum",
         "jax.numpy.einsum",
         "jax.numpy.dot",
         "jax.numpy.matmul",
+        "jax.numpy.tensordot",
+        "jax.numpy.vdot",
+        "jax.numpy.inner",
         "jax.ops.segment_sum",
     }
 )

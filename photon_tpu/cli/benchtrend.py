@@ -103,6 +103,18 @@ TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
     # workload) fail the trend gate the round it happens.
     "fused_fit_peak_hbm_bytes": ("lower", 1.5, ()),
     "serving_peak_hbm_bytes": ("lower", 1.5, ()),
+    # Mixed-precision parity (round 17+, tier-5 numerics): the measured
+    # max relative coefficient error of the bf16 fused fit vs the f32
+    # reference, per GLM family (bench run_parity). The fixed per-family
+    # tolerances live in tests/test_precision.py and PERFORMANCE.md —
+    # this line gates the TREND underneath them, so a parity gap that
+    # quietly widens (new cast, changed solver routing) fails the round
+    # it moves, long before it reaches the fixed ceiling. Lower is
+    # better; 1.5x matches the tier-5 NUMERICS_AUDIT budget band.
+    "parity_gap_linear": ("lower", 1.5, ()),
+    "parity_gap_logistic": ("lower", 1.5, ()),
+    "parity_gap_poisson": ("lower", 1.5, ()),
+    "parity_gap_smoothed_hinge": ("lower", 1.5, ()),
 }
 
 # Waivers for BENCH-REPORTED regressions (the `regressions` list a

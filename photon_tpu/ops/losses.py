@@ -84,8 +84,38 @@ def _squared_loss(z: Array, y: Array) -> Array:
     return 0.5 * d * d
 
 
+# exp() overflows f32 (and bf16 — same exponent range) at z ~= 88.7, and
+# a single inf poisons every reduction it feeds. Mirror the
+# softplus-stable logistic path: treat any margin beyond
+# POISSON_MAX_MARGIN as the threshold itself. e^30 ~= 1.1e13 keeps the
+# loss, gradient, and Hessian finite in f32 with ~1e25 of row-sum
+# headroom, and a margin of 30 already means the fit has diverged by 13
+# decades — the clamped gradient still points the solver back down.
+# Clamping the margin (not just exp's argument) keeps loss/dz/dzz the
+# exact derivatives of one shared 1-D function, so the autodiff-oracle
+# tests hold on the whole clamped region.
+POISSON_MAX_MARGIN = 30.0
+
+
+def _poisson_margin(z: Array) -> Array:
+    return jnp.minimum(z, POISSON_MAX_MARGIN)
+
+
 def _poisson_loss(z: Array, y: Array) -> Array:
-    return jnp.exp(z) - y * z
+    zc = _poisson_margin(z)
+    return jnp.exp(zc) - y * zc
+
+
+def _poisson_dz(z: Array, y: Array) -> Array:
+    return jnp.exp(_poisson_margin(z)) - y
+
+
+def _poisson_dzz(z: Array, y: Array) -> Array:
+    return jnp.exp(_poisson_margin(z))
+
+
+def _poisson_mean(z: Array) -> Array:
+    return jnp.exp(_poisson_margin(z))
 
 
 def _sign_label(y: Array) -> Array:
@@ -126,9 +156,9 @@ SQUARED = PointwiseLoss(
 POISSON = PointwiseLoss(
     name="poisson",
     loss=_poisson_loss,
-    dz=lambda z, y: jnp.exp(z) - y,
-    dzz=lambda z, y: jnp.exp(z),
-    mean=jnp.exp,
+    dz=_poisson_dz,
+    dzz=_poisson_dzz,
+    mean=_poisson_mean,
 )
 
 SMOOTHED_HINGE = PointwiseLoss(

@@ -20,8 +20,13 @@ are literally the plain ``jnp`` call (identical jaxpr — the default
 path cannot drift), on bf16 operands they force an f32 accumulator via
 ``preferred_element_type`` / ``dtype=``. The tier-1
 ``bf16-accumulation`` rule (analysis/rules.py) flags raw
-``jnp.sum``/``einsum``/segment-reduce calls on bf16-marked operands in
-the fused-fit modules — these helpers are the sanctioned spelling.
+``jnp.sum``/``einsum``/segment-reduce calls on bf16-marked operands
+across every audited module — the fused-fit path, ``serve/`` (bf16
+coefficient tables), and the segment-reduce fallback alike — and these
+helpers are the sanctioned spelling. The tier-5 numerics auditor
+(``--numerics``, ``NUMERICS_AUDIT`` below) is the semantic check
+behind it: the dtype-provenance walk over the traced jaxprs proves the
+accumulators really are f32, so a tier-1 suppression can cite it.
 
 Precision policy table, per-family tolerances, and the donation map
 live in PERFORMANCE.md.
@@ -45,6 +50,28 @@ _ALIASES = {
     "bf16": BFLOAT16,
     "mixed_bf16": BFLOAT16,
 }
+
+# Tier-5 numerics contract (verified by `python -m photon_tpu.analysis
+# --numerics`, see ANALYSIS.md): the policy helpers and all four GLM
+# loss families are traced over bf16-STORED margins and dtype-flow
+# checked — acc_sum/acc_einsum must accumulate f32, no family's exp()
+# may reach a reduction without a dominating clamp (the ops/losses.py
+# POISSON_MAX_MARGIN fix), and each probe's worst-case relative error
+# must price inside its declared budget: one storage rounding (u16)
+# plus one f32 accumulation step (u32) per reduced row.
+NUMERICS_AUDIT = dict(
+    name="precision-policy-numerics",
+    entry="ops.precision.acc_sum/acc_einsum + ops.losses families",
+    builder="build_precision_numerics",
+    budgets={
+        "acc_sum": "u16 + u32 * m",
+        "acc_einsum": "u16 + u32 * k",
+        # three acc_sum reductions per family probe (loss + curvature
+        # + link); families whose dzz is constant price below this
+        "loss_*": "u16 + 3 * u32 * m",
+    },
+    tolerance=1.5,
+)
 
 
 def resolve(name: str | None) -> str:

@@ -82,6 +82,34 @@ PROGRAM_AUDIT = dict(
     hot_loop=True,
 )
 
+# Tier-5 numerics contract (`--numerics`, ANALYSIS.md): both engage
+# modes are dtype-flow walked on bf16 values — the forced Pallas
+# kernel (interpreted off-TPU) and the XLA segment_sum fallback. The
+# kernel's windowed one-hot contraction replaces the scatter entirely
+# (no nondeterministic family at all — the determinism story is
+# by-construction); the fallback's scatter-add rides on the sorted-ids
+# precondition. Budget: one storage rounding + up to 2 f32
+# accumulation steps per element (the kernel re-reduces each streamed
+# window tile once).
+NUMERICS_AUDIT = dict(
+    name="segment-reduce-numerics",
+    entry="ops.segment_reduce.sorted_segment_sum",
+    covers=("segment-reduce-kernel",),
+    builder="build_segment_reduce_numerics",
+    budgets={
+        "segment_sum_*": "u16 + 2 * u32 * m",
+    },
+    deterministic={
+        "segment_sum_fallback:scatter-add": (
+            "ids are sorted by precondition "
+            "(indices_are_sorted=True): each segment's colliding adds "
+            "form one contiguous run that XLA combines in index order; "
+            "the kernel path removes the scatter entirely"
+        ),
+    },
+    tolerance=1.5,
+)
+
 # Trace-time site registry (host-side): every kernel instantiation
 # records its static shape here so FusedFit._ledger_record /
 # cli.profile can register a priced census row for the kernel without

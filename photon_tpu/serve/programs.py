@@ -55,6 +55,31 @@ MEMORY_AUDIT = dict(
     tolerance=1.5,
 )
 
+# Tier-5 numerics contract (`--numerics`, ANALYSIS.md): the score
+# ladder traced over bf16 CoefficientTables — the production serving
+# precision. Score reductions against the bf16 tables must accumulate
+# f32 (models/game.py acc_sum/acc_einsum); request payloads stay f32.
+# Budget per rung: one table storage rounding + one f32 accumulation
+# step per reduced coefficient column.
+NUMERICS_AUDIT = dict(
+    name="serving-numerics",
+    entry="serve.programs.ScorePrograms (score ladder rungs)",
+    covers=("serving",),
+    builder="build_serving_numerics",
+    budgets={
+        "score_b*": "u16 + u32 * (d + du + 2 * s)",
+    },
+    deterministic={
+        "score_b*:scatter": (
+            "the passive-row score set (models/game.py "
+            "_passive_score_set_*) scatters into unique request-row "
+            "indices — each row is written at most once per batch, so "
+            "no colliding writes exist to order"
+        ),
+    },
+    tolerance=1.5,
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class ShapeLadder:

@@ -1,0 +1,19 @@
+"""Tier-5 violating fixture: the static error budgets (check 3).
+
+``chained_roundings`` pushes a bf16-stored vector through several
+narrowing casts and an f32 reduction — rounds and reduce_len are both
+nonzero, so a too-small declared budget is ``numerics-undeclared-error``
+and an absurdly large one is ``numerics-stale-budget`` (the tier-4
+dual gate applied to error instead of bytes).
+
+Traced (never executed) by tests/test_analysis_numerics.py.
+"""
+
+import jax.numpy as jnp
+
+
+def chained_roundings(x):
+    a = x.astype(jnp.float32) * 2.0
+    b = a.astype(jnp.bfloat16).astype(jnp.float32) + 1.0
+    c = b.astype(jnp.bfloat16).astype(jnp.float32)
+    return jnp.sum(c, dtype=jnp.float32), b

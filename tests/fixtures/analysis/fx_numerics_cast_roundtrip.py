@@ -1,0 +1,43 @@
+"""Tier-5 violating fixture: the cast census (check 2).
+
+- ``pointless_roundtrip``: a single-use f32->bf16->f32 round-trip —
+  the value is rounded twice and stored never
+  (``numerics-cast-roundtrip``);
+- ``downcast_accumulator``: an f32 accumulator output downcast to
+  bf16 and then RE-reduced — the accumulated precision is thrown away
+  between reduction stages (``numerics-acc-downcast``). The downcast
+  value is also returned so the round-trip rule (single-use only)
+  stays out of the way;
+- ``scan_recast``: a loop-carried f32 value re-rounded to bf16 every
+  scan iteration (``numerics-scan-recast``).
+
+Traced (never executed) by tests/test_analysis_numerics.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def pointless_roundtrip(x):
+    return jnp.sum(
+        x.astype(jnp.bfloat16).astype(jnp.float32), dtype=jnp.float32
+    )
+
+
+def downcast_accumulator(x2d):
+    partial = jnp.sum(x2d, axis=0, dtype=jnp.float32)
+    stored = partial.astype(jnp.bfloat16)
+    total = jnp.sum(stored.astype(jnp.float32), dtype=jnp.float32)
+    return total, stored
+
+
+def scan_recast(xs):
+    def body(c, xi):
+        c = (c.astype(jnp.float32) + xi).astype(jnp.bfloat16)
+        return c, c
+
+    _, ys = jax.lax.scan(
+        body, jnp.zeros(xs.shape[1:], jnp.bfloat16), xs,
+        length=xs.shape[0],
+    )
+    return ys

@@ -1,0 +1,31 @@
+"""Tier-5 violating fixture: bf16 ACCUMULATION (check 1).
+
+Two spellings of the same sin — a reduction whose accumulator
+silently inherits the bf16 operand dtype:
+
+- ``bf16_dot``: a dot_general over bf16 operands with no
+  ``preferred_element_type=float32`` — the MXU accumulates bf16;
+- ``bf16_scan_accumulate``: a scan whose bf16 carry is the running
+  sum — one bf16 rounding of the accumulated value per iteration.
+
+Traced (never executed) by tests/test_analysis_numerics.py; each must
+produce exactly a ``numerics-bf16-accumulation`` finding.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def bf16_dot(a, b):
+    return jnp.dot(a, b)
+
+
+def bf16_scan_accumulate(xs):
+    def body(c, xi):
+        c = c + jnp.sum(xi, dtype=jnp.float32).astype(jnp.bfloat16)
+        return c, ()
+
+    total, _ = jax.lax.scan(
+        body, jnp.zeros((), jnp.bfloat16), xs, length=xs.shape[0]
+    )
+    return total

@@ -1,0 +1,17 @@
+"""Tier-5 violating fixture: the reduction-determinism census
+(check 4).
+
+``undeclared_scatter_add`` accumulates through ``.at[].add`` with
+arbitrary (possibly colliding, unsorted) indices and NO
+deterministic-by-construction declaration — XLA does not pin the
+combination order of colliding scatter indices, and f32 addition is
+not associative, so the result is run-to-run nondeterministic. Must
+produce ``numerics-nondeterministic-reduce`` unless the contract
+declares why collisions cannot matter.
+
+Traced (never executed) by tests/test_analysis_numerics.py.
+"""
+
+
+def undeclared_scatter_add(table, ids, values):
+    return table.at[ids].add(values)

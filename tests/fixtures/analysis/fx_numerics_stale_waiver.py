@@ -1,0 +1,22 @@
+"""Tier-5 violating fixture: the coverage gate (check 5).
+
+Waiver-rot data fed into ``check_coverage`` by
+tests/test_analysis_numerics.py:
+
+- ``STALE_WAIVER`` names a tier-2 contract that does not exist — a
+  waiver that outlived the program it excused;
+- ``REASONLESS_WAIVER`` waives a real contract with an empty reason —
+  a gap dressed as a decision;
+- ``BOGUS_COVERS`` is a declaration claiming to cover a tier-2 name
+  that was never declared.
+
+Each must produce a ``numerics-contract`` finding.
+"""
+
+STALE_WAIVER = {
+    "long-retired-contract": "the traced program was deleted long ago"
+}
+
+REASONLESS_WAIVER = {"telemetry": "   "}
+
+BOGUS_COVERS = ("no-such-tier2-contract",)

@@ -80,6 +80,39 @@ def test_poisson_reference_values():
         rtol=1e-12)
 
 
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_poisson_stability_at_extreme_margins(dtype):
+    # Raw exp(z) overflows f32/bf16 at z ~= 88.7 and an inf poisons any
+    # reduction it feeds; margins beyond POISSON_MAX_MARGIN are treated
+    # as the threshold itself (losses.py), so loss/dz/dzz/mean stay
+    # finite at any margin in BOTH storage precisions.
+    z = jnp.asarray([-500.0, 200.0, 500.0], dtype=dtype)
+    y = jnp.asarray([1.0, 2.0, 3.0], dtype=dtype)
+    for fn in (lambda: losses.POISSON.loss(z, y),
+               lambda: losses.POISSON.dz(z, y),
+               lambda: losses.POISSON.dzz(z, y),
+               lambda: losses.POISSON.mean(z)):
+        v = np.asarray(fn(), dtype=np.float32)
+        assert np.all(np.isfinite(v)), v
+
+
+def test_poisson_clamp_matches_raw_below_threshold():
+    # The clamp is invisible on the whole realistic margin range: at
+    # z < POISSON_MAX_MARGIN every quantity equals the raw-exp form.
+    z = jnp.asarray([-8.0, 0.0, 4.0, losses.POISSON_MAX_MARGIN - 1.0])
+    y = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+    zn, yn = np.asarray(z), np.asarray(y)
+    np.testing.assert_allclose(
+        losses.POISSON.loss(z, y), np.exp(zn) - yn * zn, rtol=1e-6)
+    np.testing.assert_allclose(
+        losses.POISSON.dz(z, y), np.exp(zn) - yn, rtol=1e-6)
+    np.testing.assert_allclose(losses.POISSON.dzz(z, y), np.exp(zn),
+                               rtol=1e-6)
+    np.testing.assert_allclose(losses.POISSON.mean(z), np.exp(zn),
+                               rtol=1e-6)
+
+
 def test_mean_link_functions():
     z = jnp.asarray([0.0])
     assert losses.LOGISTIC.mean(z)[0] == pytest.approx(0.5)
