@@ -866,8 +866,33 @@ def run_serving() -> dict:
         summary_reload = drive(queue, requests)
         reload_events = compile_event_count() - reload_before
         health = queue.health()
+        queue_stats = queue.stats()
     compile_events = compile_event_count() - before
+    attribution = ledger.attribution_since(ledger_mark)
+    # Dispatch-gap attribution: the fraction of the serve rows' wall
+    # the host spent BETWEEN device dispatches (pack, queue pop, fetch
+    # turnaround). The staging pipeline exists to shrink exactly this
+    # number, so it is measured against a SERIAL baseline
+    # (pipeline_staging=False) driven in the same round with the same
+    # programs and requests — benchtrend ratchets the pipelined
+    # fraction (`serving_dispatch_gap_fraction`).
+    serial_mark = ledger.mark()
+    with MicroBatchQueue(
+        programs, max_linger_s=SERVE_MAX_LINGER_MS / 1e3,
+        pipeline_staging=False,
+    ) as serial_queue:
+        summary_serial = drive(serial_queue, requests)
+    serial_attribution = ledger.attribution_since(serial_mark)
+    parity = _serve_kernel_parity()
     return {
+        "serving_dispatch_gap_fraction": _serve_gap_fraction(attribution),
+        "serving_dispatch_gap_fraction_serial": _serve_gap_fraction(
+            serial_attribution),
+        "serving_p99_ms_serial": summary_serial["p99_ms"],
+        "serving_staging_overlap_fraction": queue_stats[
+            "staging_overlap_fraction"],
+        "serving_staged_batches": queue_stats["staged_batches"],
+        **parity,
         "serving_reload_values_only": bool(
             reload_info.get("values_only")),
         "serving_reload_compile_events": reload_events,
@@ -878,7 +903,7 @@ def run_serving() -> dict:
         # Cost-ledger view of the drive: per-rung dispatch rows
         # (seconds, dispatch counts, host gaps) — which rung the wall
         # actually went to, next to the latency percentiles.
-        "serving_attribution": ledger.attribution_since(ledger_mark),
+        "serving_attribution": attribution,
         "serving_requests": summary["requests"],
         "serving_p50_ms": summary["p50_ms"],
         "serving_p90_ms": summary["p90_ms"],
@@ -910,6 +935,119 @@ def run_serving() -> dict:
         # bench run every shed/deadline/retry/breaker counter must be
         # zero — gated in serving_regressions.
         "serving_health": health,
+    }
+
+
+def _serve_gap_fraction(attribution: dict) -> float | None:
+    """Host-gap share of the serve rows' accounted wall: sum of the
+    per-rung ``host_gap_seconds`` over (gap + measured dispatch
+    seconds). 0 = every accounted second was device execution; the
+    staging pipeline's job is to push this toward 0."""
+    gap = seconds = 0.0
+    for row in attribution.get("rows", []):
+        if row.get("phase") != "serve":
+            continue
+        gap += row.get("host_gap_seconds", 0.0)
+        seconds += row.get("seconds", 0.0)
+    total = gap + seconds
+    return round(gap / total, 4) if total > 0.0 else None
+
+
+def _serve_kernel_parity() -> dict:
+    """Fused-serve-kernel vs jitted-chain parity on ONE packed rung at
+    the bench precision (the runtime twin of tests/test_serve_kernel.py:
+    same model structure, production pack path, forced kernel —
+    interpreted off-TPU). Gated at 5e-2 in serving_regressions; bf16
+    tables round identically on both paths so the observed gap is the
+    accumulation-order delta only."""
+    from photon_tpu.serve.driver import synthetic_requests
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+
+    model = build_serving_model(seed=1311)
+    prev = os.environ.get("PHOTON_SERVE_KERNEL")
+    outs = {}
+    try:
+        for mode in ("off", "force"):
+            os.environ["PHOTON_SERVE_KERNEL"] = mode
+            tables = CoefficientTables.from_game_model(
+                model, precision=BENCH_PRECISION
+            )
+            progs = ScorePrograms(
+                tables, ladder=ShapeLadder((8,)), compile_now=False
+            )
+            progs.compile_rung(8)
+            reqs = synthetic_requests(
+                tables, progs, 8, cold_fraction=0.25, seed=11
+            )
+            feats, codes, _ = progs.pack_requests(reqs)
+            outs[mode] = np.asarray(
+                progs.score_padded(feats, codes, len(reqs)),
+                dtype=np.float64,
+            )
+    finally:
+        if prev is None:
+            os.environ.pop("PHOTON_SERVE_KERNEL", None)
+        else:
+            os.environ["PHOTON_SERVE_KERNEL"] = prev
+    return {
+        "serving_kernel_parity_maxdiff": float(
+            np.max(np.abs(outs["off"] - outs["force"]))
+        ),
+        "serving_kernel_parity_tolerance": 5e-2,
+    }
+
+
+def run_serve_kernel_micro() -> dict:
+    """Standalone fused-serve-kernel dispatch at the top rung: achieved
+    bytes/s next to the kernel's analytic HBM traffic (the
+    benchtrend-tracked ``serve_kernel_bytes_per_sec`` gauge). Skipped
+    where the kernel does not serve this backend — interpret mode would
+    measure the Pallas interpreter, not HBM."""
+    from photon_tpu.ops import serve_kernel
+    from photon_tpu.serve.driver import synthetic_requests
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+
+    if serve_kernel.interpret_required() or not (
+        serve_kernel.kernel_supported(BENCH_PRECISION)
+    ):
+        return {}
+    import jax
+
+    rung = max(SERVE_RUNGS)
+    tables = CoefficientTables.from_game_model(
+        build_serving_model(seed=1312), precision=BENCH_PRECISION
+    )
+    progs = ScorePrograms(
+        tables, ladder=ShapeLadder((rung,)), compile_now=False
+    )
+    assert progs.use_kernel
+    progs.compile_rung(rung)
+    reqs = synthetic_requests(
+        tables, progs, rung, cold_fraction=SERVE_COLD_FRACTION, seed=12
+    )
+    feats, codes, _ = progs.pack_requests(reqs)
+    jax.block_until_ready(
+        progs.dispatch_padded(feats, codes, rung).out
+    )
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        handle = progs.dispatch_padded(feats, codes, rung)
+    jax.block_until_ready(handle.out)
+    dt = time.perf_counter() - t0
+    info = serve_kernel.traced_sites().get("serve_kernel/score") or {}
+    bytes_per_call = (info.get("cost") or {}).get("hbm_bytes", 0.0)
+    return {
+        "serve_kernel_rung": rung,
+        "serve_kernel_bytes_per_call": bytes_per_call,
+        "serve_kernel_bytes_per_sec": round(
+            bytes_per_call * reps / dt, 1) if dt else None,
+        "serve_kernel_fraction_of_hbm_peak": (
+            round(bytes_per_call * reps / dt / PEAK_HBM_BYTES, 6)
+            if dt else None
+        ),
     }
 
 
@@ -1779,6 +1917,25 @@ def serving_regressions(serving: dict) -> list[str]:
             f"(burn short={err.get('burn_short')} "
             f"long={err.get('burn_long')}; must be zero without "
             "injected faults)")
+    # Fused-kernel score parity: the forced kernel and the jitted
+    # per-coordinate chain score the same packed rung within the bf16
+    # accumulation-order band. A wider gap means the kernel computes a
+    # DIFFERENT model, not a slower one.
+    maxdiff = serving.get("serving_kernel_parity_maxdiff")
+    tol = serving.get("serving_kernel_parity_tolerance", 5e-2)
+    if maxdiff is not None and maxdiff > tol:
+        out.append(
+            f"serve-kernel parity maxdiff {maxdiff:.3e} > {tol:.0e} "
+            "(fused kernel diverges from the jitted score chain)")
+    # The pipelined queue must never strand a staged batch: the serial
+    # replay and the pipelined drive answer the same requests, so both
+    # summaries' request counts match by construction — but a staging
+    # pipeline that silently fell back to serial would report zero
+    # staged batches here.
+    if serving.get("serving_staged_batches", 0) == 0:
+        out.append(
+            "pipelined queue staged zero batches (double-buffered "
+            "staging silently disabled)")
     return out
 
 
@@ -2332,6 +2489,7 @@ def main(argv=None):
     pilot = run_pilot()
     drift = run_drift()
     kernel_micro = run_kernel_micro()
+    serve_kernel_micro = run_serve_kernel_micro()
     parity = run_parity()
     sklearn_anchor = run_sklearn_baseline(logi["train_seconds"])
     yahoo = run_yahoo_music()
@@ -2387,6 +2545,7 @@ def main(argv=None):
     out.update(pilot)
     out.update(drift)
     out.update(kernel_micro)
+    out.update(serve_kernel_micro)
     out.update(parity)
     out.update(sklearn_anchor)
     out.update(yahoo)

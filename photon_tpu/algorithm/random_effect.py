@@ -414,6 +414,95 @@ def _spd_solve_cg_sb(h_sb: Array, b_sb: Array, sub_dim: int,
     return x
 
 
+def _solve_direct_gram(
+    block,  # EntityBlocks, ELL layout (x_indices is not None)
+    offsets: Array,  # [B, R] effective offsets (residuals folded in)
+    factors_sub: Array | None,  # [B, S]
+    prior: tuple[Array, Array] | None,  # ([B, S], [B, S])
+    *,
+    sub_dim: int,
+    l2_weight: Array,
+    incremental_weight: Array,
+    gram_mults: tuple,
+):
+    """Whole-bucket exact squared-loss solve straight from the ELL layout.
+
+    The wide-subspace direct path previously materialized a dense
+    [B, R, S] slab (per entity, or bucket-wide via densify_ell_blocks)
+    just to form X^T W X — at wide S that slab is the dominant HBM
+    object of the whole solve. But the normal equations only need the
+    [B, S, S] gram blocks and the [B, S] moment vector, and BOTH are
+    segment sums over the ELL entries: pair products w * v_j * v_l land
+    in gram segment (entity, slot_j, slot_l), weighted targets in
+    (entity, slot). The tiled segment-reduce (ops/segment_reduce)
+    aggregates them with the host-computed window bounds sizing
+    coverage (``gram_mults`` = data/random_effect.block_gram_mults) —
+    the dense slab never exists.
+
+    Engagement is gated by ``_solve_block`` (direct + ELL + no shifts +
+    no variances + kernel-served shape). Normalization factors fold in
+    AFTER the reduce: X' = X F gives H' = F H F and b' = F b (diagonal
+    congruence) — the same algebra the per-entity solver applies
+    row-wise before aggregating.
+    """
+    dtype = block.labels.dtype
+    s = sub_dim
+    grad_mult, hess_mult = gram_mults
+    gram = segment_reduce.ell_gram_blocks(
+        block.x_indices, block.x_values, block.weights, s,
+        multiplicity=hess_mult,
+    )
+    y_eff = (block.labels - offsets) * block.weights
+    bvec = segment_reduce.ell_segment_slots(
+        block.x_indices, block.x_values, y_eff, s,
+        multiplicity=grad_mult,
+    )
+    assert gram is not None and bvec is not None  # ell_gram_supported gate
+    h = gram.astype(dtype)
+    b_vec = bvec.astype(dtype)
+    if factors_sub is not None:
+        h = h * factors_sub[:, :, None] * factors_sub[:, None, :]
+        b_vec = b_vec * factors_sub
+    valid_mask = block.valid_mask
+    if prior is not None:
+        # Shifts are None on this route, so the transformed prior means
+        # are just the factor-rescaled originals (no intercept fold).
+        m_t = _coef_to_transformed(prior[0], factors_sub, None, None)
+        f_sq = 1.0 if factors_sub is None else factors_sub * factors_sub
+        inv_prior_var = optim.inverse_prior_variances(
+            prior[1] / f_sq, l2_weight) * valid_mask
+        l2_diag = incremental_weight * inv_prior_var
+        b_vec = b_vec + l2_diag * m_t
+    else:
+        l2_diag = l2_weight * block.penalty_mask
+    # Padding slots get a unit diagonal so the system stays PD; their
+    # gradient is masked (identical to the per-entity solver).
+    h = h + jnp.eye(s, dtype=dtype) * (
+        l2_diag + (1.0 - valid_mask))[:, None, :]
+    # Batch-minor CG (compact lanes, see _spd_solve_cg_sb) plus one
+    # refinement pass — matching the refined default the per-entity
+    # direct solver gets from _spd_solve_cg.
+    h_sb = jnp.transpose(h, (1, 2, 0))
+    b_sb = jnp.transpose(b_vec)
+    active = jnp.ones(b_vec.shape[0], bool)
+    sol = _spd_solve_cg_sb(h_sb, b_sb, s, active)
+    res = b_sb - jnp.sum(h_sb * sol[None, :, :], axis=1)
+    sol = sol + _spd_solve_cg_sb(h_sb, res, s, active)
+    w_t = jnp.transpose(sol).astype(dtype) * valid_mask
+    w = _coef_to_original(w_t, factors_sub, None, None) * valid_mask
+    bsz = w.shape[0]
+    return (
+        w,
+        jnp.zeros_like(w),
+        jnp.ones(bsz, jnp.int32),
+        jnp.full(
+            bsz,
+            int(optim.ConvergenceReason.GRADIENT_CONVERGED),
+            jnp.int32,
+        ),
+    )
+
+
 def _solve_newton_batched(
     x: Array,  # [B, R, S] dense slab (raw, untransformed)
     labels: Array,  # [B, R]
@@ -978,7 +1067,7 @@ def _solve_one_entity(
     jax.jit,
     static_argnames=(
         "sub_dim", "task", "opt_config", "use_owlqn", "variance_computation",
-        "direct", "newton", "precision",
+        "direct", "newton", "precision", "gram_mults",
     ),
     # Buffer donation through _scatter_results: the [E, Smax] coefficient
     # and variance tables are CARRIES — each bucket's scatter returns the
@@ -1011,6 +1100,7 @@ def _solve_block(
     direct: bool = False,
     newton: bool = False,
     precision: str = "float32",
+    gram_mults: tuple | None = None,
 ):
     """One bucket's batched per-entity solve (everything traced/fused).
 
@@ -1066,7 +1156,29 @@ def _solve_block(
                 block.x_indices, block.x_values, sub_dim
             ),
         )
-    elif block.x_indices is not None and (newton or direct):
+    # Wide-ELL direct solves can skip densification ENTIRELY: the normal
+    # equations only need X^T W X and X^T W y, which _solve_direct_gram
+    # aggregates straight from the ELL entries through the tiled
+    # segment-reduce. Engagement needs the planner's host-computed
+    # window bounds (gram_mults), no shift normalization (shifts break
+    # ELL sparsity), no variance computation (variances read the dense
+    # design), and a kernel-served shape — everything static.
+    gram_route = (
+        direct
+        and gram_mults is not None
+        and shifts_full is None
+        and variance_computation == VarianceComputationType.NONE
+        and block.x_indices is not None
+        and segment_reduce.ell_gram_supported(
+            *block.x_indices.shape, sub_dim,
+            grad_mult=gram_mults[0], hess_mult=gram_mults[1],
+        )
+    )
+    if (
+        block.x_indices is not None
+        and (newton or direct)
+        and not gram_route
+    ):
         # Wide-subspace ELL: one flat tiled segment-reduce densifies the
         # WHOLE bucket (ops/segment_reduce) where the kernel serves this
         # backend — routing it onto the batched dense solvers instead of
@@ -1118,6 +1230,19 @@ def _solve_block(
             )[:, :s],
         )
     if direct:
+        if gram_route:
+            w, v, it, reason = _solve_direct_gram(
+                block,
+                offsets,
+                factors_sub,
+                prior,
+                sub_dim=sub_dim,
+                l2_weight=l2_weight,
+                incremental_weight=incremental_weight,
+                gram_mults=gram_mults,
+            )
+            return _scatter_results(w_all, v_all, codes, w, v, it, reason)
+
         def direct_solver(xi, xv, lb, off, wt, pm, vm, f, sh, islot, prior_e):
             return _solve_one_entity_direct(
                 xi, xv, lb, off, wt, pm, vm, f, sh, islot, prior_e,
@@ -1263,12 +1388,15 @@ class RandomEffectCoordinate:
     # is the historical path. A declared recompile key (PERFORMANCE.md).
     precision: str = "float32"
 
-    def _dispatch_block(self, block, residuals, w0_full, w_all, v_all):
+    def _dispatch_block(self, block, residuals, w0_full, w_all, v_all,
+                        block_index=None):
         """Assemble and dispatch one bucket's ``_solve_block`` call.
 
         Shared by ``train`` (sequential scatter into the tables) and
         ``warmup_thunks`` (concurrent compile priming), so the jit call
-        structure cannot drift between them.
+        structure cannot drift between them. ``block_index`` keys the
+        planner's host-side per-bucket tables (gram window bounds); both
+        callers enumerate ``device_blocks()`` so the statics agree.
         """
         dtype = jnp.dtype(self.dataset.dtype)
         # Squared-loss subproblems are convex quadratics: solve them
@@ -1298,6 +1426,15 @@ class RandomEffectCoordinate:
         newton = well_posed and self.task in (
             TaskType.LOGISTIC_REGRESSION, TaskType.POISSON_REGRESSION
         )
+        # Host-computed gram window bounds for this bucket (None when
+        # the planner skipped them — small subspaces densify, lazy
+        # datasets have no host slab view): the static coverage key of
+        # the direct ELL gram route (_solve_direct_gram).
+        gram_mults = None
+        if block_index is not None:
+            gm = getattr(self.dataset, "block_gram_mults", ())
+            if block_index < len(gm):
+                gram_mults = gm[block_index]
         # Scalars ride as host float32 jit operands (an eager
         # jnp.asarray would compile its own convert program per call
         # site on the TPU backend).
@@ -1322,6 +1459,7 @@ class RandomEffectCoordinate:
             direct=direct,
             newton=newton,
             precision=precision_mod.resolve(self.precision),
+            gram_mults=gram_mults,
         )
 
     def warmup_thunks(self):
@@ -1343,7 +1481,7 @@ class RandomEffectCoordinate:
             else None
         )
 
-        def block_thunk(block):
+        def block_thunk(block, idx):
             # w_all/v_all are DONATED by _solve_block: each thunk gets
             # its own fresh tables — reusing w0_full as w_all would
             # alias a donated buffer with a live operand, and a shared
@@ -1352,7 +1490,8 @@ class RandomEffectCoordinate:
                 w_tab = jnp.zeros_like(w0_full)
                 v_tab = None if v_all is None else jnp.zeros_like(v_all)
                 jax.block_until_ready(self._dispatch_block(
-                    block, residuals, w0_full, w_tab, v_tab
+                    block, residuals, w0_full, w_tab, v_tab,
+                    block_index=idx,
                 )[0])
 
             return thunk
@@ -1369,7 +1508,9 @@ class RandomEffectCoordinate:
             )
             jax.block_until_ready(self.score(model))
 
-        return [block_thunk(b) for b in ds.device_blocks()] + [score_thunk]
+        return [
+            block_thunk(b, i) for i, b in enumerate(ds.device_blocks())
+        ] + [score_thunk]
 
     def train(
         self,
@@ -1425,9 +1566,11 @@ class RandomEffectCoordinate:
         # Feature slabs materialize on device once per dataset; per-solve
         # gathers shrink to the [B, R] residual rows (data/random_effect.py
         # device_blocks).
-        for block, real in zip(ds.device_blocks(), real_masks):
+        for i, (block, real) in enumerate(
+            zip(ds.device_blocks(), real_masks)
+        ):
             w_all, v_all, it, reason = self._dispatch_block(
-                block, residuals, w0_full, w_all, v_all
+                block, residuals, w0_full, w_all, v_all, block_index=i
             )
             # Keep diagnostics on device; fetch once after the loop
             # (a per-block np.asarray would sync per block).

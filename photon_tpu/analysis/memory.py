@@ -80,6 +80,7 @@ MEMORY_RULES: dict[str, str] = {
 # analysis machinery.
 MEMORY_DECLARING_MODULES = (
     "photon_tpu.algorithm.fused_fit",
+    "photon_tpu.ops.serve_kernel",
     "photon_tpu.serve.programs",
     "photon_tpu.serve.tables",
     "photon_tpu.pilot.serving",
@@ -926,6 +927,70 @@ def build_serving_memory() -> MemoryTrace:
     )
 
 
+def build_serve_kernel_memory() -> MemoryTrace:
+    """The fused serve kernel's per-rung peaks (PHOTON_SERVE_KERNEL
+    forced so the pallas path is what gets walked; env restored after).
+
+    The kernel's memory story vs the jit chain is the ABSENCE of the
+    gathered intermediates: the live set is the resident tables plus
+    the padded request payloads and the [rung] output — no [rung, s]
+    gathered coefficient rows, no [rung, k, s] one-hot operand. The
+    budget formula in ops/serve_kernel.MEMORY_AUDIT prices exactly
+    that, so a lowering regression that rematerializes a gather
+    surfaces as memory-undeclared-growth here."""
+    import os
+
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+
+    d, e, s, du = 5, 7, 3, 6
+    model = _tiny_game_model(
+        d, e, s, du, proj_seed=1234, rng_seed=20260803
+    )
+    ladder = ShapeLadder((1, 8, 64))
+    prev = os.environ.get("PHOTON_SERVE_KERNEL")
+    os.environ["PHOTON_SERVE_KERNEL"] = "force"
+    try:
+        tables = CoefficientTables.from_game_model(model)
+        programs = ScorePrograms(
+            tables, ladder=ladder, compile_now=False
+        )
+        if not programs.use_kernel:
+            raise RuntimeError(
+                "PHOTON_SERVE_KERNEL=force did not engage the fused "
+                "kernel — the serve-kernel memory contract audits "
+                "nothing"
+            )
+        traced = {
+            f"serve_kernel_b{r}": ProgramMemory(
+                name=f"serve_kernel_b{r}",
+                jaxpr=(t := programs.trace(r)).jaxpr,
+                lowered=t.lower(),
+                dims={"rung": float(r)},
+            )
+            for r in ladder.rungs
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("PHOTON_SERVE_KERNEL", None)
+        else:
+            os.environ["PHOTON_SERVE_KERNEL"] = prev
+    return MemoryTrace(
+        programs=traced,
+        dims={
+            "d": float(d),
+            "e": float(e),
+            "s": float(s),
+            "du": float(du),
+            "wbytes": 4.0,
+        },
+        notes=[
+            f"fused kernel over ladder {ladder.rungs}, tier-2 serving "
+            "fixture model, f32 tables, interpret-path lowering",
+        ],
+    )
+
+
 def build_tables_memory() -> MemoryTrace:
     """Resident tables at BOTH precisions vs the admission oracle, and
     the rebuild_from double-residency transient."""
@@ -999,6 +1064,7 @@ def build_pilot_serving_memory() -> MemoryTrace:
 
 _BUILDERS: dict[str, Callable[[], MemoryTrace]] = {
     "build_fused_fit_memory": build_fused_fit_memory,
+    "build_serve_kernel_memory": build_serve_kernel_memory,
     "build_serving_memory": build_serving_memory,
     "build_tables_memory": build_tables_memory,
     "build_pilot_serving_memory": build_pilot_serving_memory,

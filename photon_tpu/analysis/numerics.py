@@ -132,6 +132,7 @@ NUMERICS_DECLARING_MODULES = (
     "photon_tpu.ops.precision",
     "photon_tpu.algorithm.fused_fit",
     "photon_tpu.ops.segment_reduce",
+    "photon_tpu.ops.serve_kernel",
     "photon_tpu.serve.programs",
 )
 
@@ -1345,6 +1346,60 @@ def build_segment_reduce_numerics() -> NumericsTrace:
     )
 
 
+def build_serve_kernel_numerics() -> NumericsTrace:
+    """The fused serve kernel over bf16 tables (PHOTON_SERVE_KERNEL
+    forced; env restored after) — the production serving precision
+    through the pallas path, next to ``build_serving_numerics``'s jit
+    fallback on the same fixture."""
+    import os
+
+    from photon_tpu.analysis.memory import _tiny_game_model
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+
+    d, e, s, du = 5, 7, 3, 6
+    model = _tiny_game_model(
+        d, e, s, du, proj_seed=1234, rng_seed=20260803
+    )
+    ladder = ShapeLadder((1, 8))
+    prev = os.environ.get("PHOTON_SERVE_KERNEL")
+    os.environ["PHOTON_SERVE_KERNEL"] = "force"
+    try:
+        tables = CoefficientTables.from_game_model(model, "bfloat16")
+        programs = ScorePrograms(
+            tables, ladder=ladder, compile_now=False
+        )
+        if not programs.use_kernel:
+            raise RuntimeError(
+                "PHOTON_SERVE_KERNEL=force did not engage the fused "
+                "kernel — the serve-kernel numerics contract audits "
+                "nothing"
+            )
+        out = {
+            f"serve_kernel_b{r}": ProgramNumerics(
+                f"serve_kernel_b{r}",
+                programs.trace(r).jaxpr,
+                dims={"rung": float(r)},
+            )
+            for r in ladder.rungs
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("PHOTON_SERVE_KERNEL", None)
+        else:
+            os.environ["PHOTON_SERVE_KERNEL"] = prev
+    return NumericsTrace(
+        programs=out,
+        dims={
+            "d": float(d), "e": float(e), "s": float(s), "du": float(du),
+        },
+        notes=[
+            f"fused kernel ladder {ladder.rungs} over BF16 tables, "
+            "interpret-path lowering; request payloads f32"
+        ],
+    )
+
+
 def build_serving_numerics() -> NumericsTrace:
     """The serve score ladder over bf16 coefficient tables — the
     production mixed-precision serving path."""
@@ -1381,6 +1436,7 @@ _BUILDERS: dict[str, Callable[[], NumericsTrace]] = {
     "build_precision_numerics": build_precision_numerics,
     "build_fused_fit_numerics": build_fused_fit_numerics,
     "build_segment_reduce_numerics": build_segment_reduce_numerics,
+    "build_serve_kernel_numerics": build_serve_kernel_numerics,
     "build_serving_numerics": build_serving_numerics,
 }
 

@@ -85,6 +85,7 @@ DECLARING_MODULES = (
     "photon_tpu.obs",
     "photon_tpu.ops.newton_kernel",
     "photon_tpu.ops.segment_reduce",
+    "photon_tpu.ops.serve_kernel",
     "photon_tpu.parallel.mesh",
     "photon_tpu.pilot",
     "photon_tpu.resilience",
@@ -848,6 +849,120 @@ def build_segment_reduce() -> ContractTrace:
     return ContractTrace(
         programs={"segment_sum": base},
         variants=variants,
+    )
+
+
+def build_serve_kernel() -> ContractTrace:
+    """The fused serve-score kernel's one-program contract.
+
+    The same tiny GLMix fixture as ``build_serving`` is loaded into
+    serving tables with ``PHOTON_SERVE_KERNEL=force`` (env restored
+    after), so ``ScorePrograms.trace`` lowers the fused pallas_call
+    instead of the per-coordinate jit chain — through the interpreter
+    path on non-TPU hosts (Mosaic lowering is TPU-only). One rung is
+    ONE program: tables, features and the scalar-prefetched codes are
+    traced operands. The declared recompile families prove the two
+    static specializations still specialize: a different ``rung``
+    (grid size) and a different ``model_structure`` (feature width)
+    must each perturb the compile key.
+    """
+    import os
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(20260806)
+
+    def model_for(d: int, e: int = 7, s: int = 3, du: int = 6):
+        prng = np.random.default_rng(1234)
+        proj = np.sort(
+            np.stack([
+                prng.permutation(du)[:s] for _ in range(e)
+            ]), axis=1,
+        ).astype(np.int64)
+        return GameModel({
+            "global": FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(means=jnp.asarray(
+                        rng.normal(size=d).astype(np.float32)
+                    )),
+                    TaskType.LOGISTIC_REGRESSION,
+                ),
+                "features",
+            ),
+            "per-user": RandomEffectModel(
+                coefficients=jnp.asarray(
+                    rng.normal(size=(e, s)).astype(np.float32)
+                ),
+                random_effect_type="userId",
+                feature_shard_id="userShard",
+                task=TaskType.LOGISTIC_REGRESSION,
+                proj_all=proj,
+                entity_keys=tuple(str(i) for i in range(e)),
+            ),
+        })
+
+    def rung_program(d: int, rung: int, *, name: str) -> TracedProgram:
+        ladder = ShapeLadder((rung,))
+        tables = CoefficientTables.from_game_model(model_for(d))
+        programs = ScorePrograms(
+            tables, ladder=ladder, compile_now=False
+        )
+        if not programs.use_kernel:
+            raise RuntimeError(
+                "PHOTON_SERVE_KERNEL=force did not engage the fused "
+                "kernel — the serve-kernel contract audits nothing"
+            )
+        traced = programs.trace(rung)
+        return TracedProgram(
+            name=name,
+            text=str(traced.jaxpr),
+            jaxpr=traced.jaxpr,
+            lowered=traced.lower(),
+        )
+
+    # The kernel path must be what gets traced here regardless of the
+    # host's backend: force it for the audit (env restored after).
+    prev = os.environ.get("PHOTON_SERVE_KERNEL")
+    os.environ["PHOTON_SERVE_KERNEL"] = "force"
+    try:
+        base = rung_program(5, 8, name="serve_kernel_b8")
+        variants = {
+            "rung": [
+                {"serve_kernel_b8": rung_program(
+                    5, r, name="v").signature}
+                for r in (1, 64)
+            ],
+            "model_structure": [
+                {"serve_kernel_b8": rung_program(
+                    9, 8, name="v").signature},
+            ],
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("PHOTON_SERVE_KERNEL", None)
+        else:
+            os.environ["PHOTON_SERVE_KERNEL"] = prev
+    return ContractTrace(
+        programs={"serve_kernel_b8": base},
+        variants=variants,
+        notes=[
+            "fused pallas_call traced through the interpret path; "
+            "tables/features/codes are traced operands — a values-only "
+            "reload re-enters the same executable (build_serving's "
+            "model_reload family covers the jit fallback)",
+        ],
     )
 
 
@@ -2038,6 +2153,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_unfused_update": build_unfused_update,
     "build_newton_kernel": build_newton_kernel,
     "build_segment_reduce": build_segment_reduce,
+    "build_serve_kernel": build_serve_kernel,
     "build_mesh_sharding": build_mesh_sharding,
     "build_ingest_pipeline": build_ingest_pipeline,
     "build_telemetry": build_telemetry,

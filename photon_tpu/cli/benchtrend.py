@@ -71,6 +71,17 @@ TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
     "segment_reduce_bytes_per_sec": ("higher", 1.5, ()),
     "serving_p99_ms": ("lower", 1.5, ()),
     "serving_qps": ("higher", 1.5, ()),
+    # Serve-latency roofline push (round 18+): the host-gap share of
+    # the serve dispatch rows' accounted wall — what the double-
+    # buffered staging pipeline exists to shrink. Bounded by 1.0, so
+    # the 1.5x band is a real ratchet once the fraction lands; the
+    # serial baseline (`serving_dispatch_gap_fraction_serial`) rides
+    # the JSON untracked for the side-by-side.
+    "serving_dispatch_gap_fraction": ("lower", 1.5, ()),
+    # Achieved HBM throughput of the fused serve-score kernel at the
+    # top rung (bench run_serve_kernel_micro; absent off-TPU — same
+    # skip-until-first-report policy as segment_reduce_bytes_per_sec).
+    "serve_kernel_bytes_per_sec": ("higher", 1.5, ()),
     # Streaming scenario (round 10+, photon_tpu.data.stream): the
     # day-over-day warm-start retrain throughput and the out-of-core
     # ingest rate — a streaming-throughput regression fails the trend

@@ -200,6 +200,101 @@ def _kernel_probe() -> dict | None:
     }
 
 
+def _serve_kernel_probe() -> dict | None:
+    """One REAL dispatch of the fused serve-score kernel under the
+    armed ledger (ops/serve_kernel): a tiny model's tables are loaded
+    at serving precision, one padded rung is scored through the fused
+    pallas path, and the kernel's trace-time census entry prices its
+    roofline row next to the jit-chain serve rows. Returns None where
+    the kernel does not serve this backend (auto mode off TPU) — the
+    profile-smoke job forces it with ``PHOTON_SERVE_KERNEL=force`` to
+    exercise the interpreter path."""
+    import numpy as np
+
+    from photon_tpu.obs import ledger
+    from photon_tpu.ops import serve_kernel as sk
+
+    if not sk.kernel_supported(np.float32):
+        return None
+    import jax.numpy as jnp
+
+    from photon_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import (
+        Coefficients,
+        GeneralizedLinearModel,
+    )
+    from photon_tpu.serve.programs import ScorePrograms, ShapeLadder
+    from photon_tpu.serve.tables import CoefficientTables
+    from photon_tpu.types import TaskType
+
+    d, e, s, du, rung = 6, 16, 3, 4, 64
+    rng = np.random.default_rng(20260806)
+    proj = np.stack([
+        np.sort(rng.choice(du, size=s, replace=False))
+        for _ in range(e)
+    ]).astype(np.int64)
+    model = GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(means=jnp.asarray(
+                    rng.normal(size=d).astype(np.float32)
+                )),
+                TaskType.LOGISTIC_REGRESSION,
+            ),
+            "features",
+        ),
+        "per-user": RandomEffectModel(
+            coefficients=jnp.asarray(
+                rng.normal(size=(e, s)).astype(np.float32)
+            ),
+            random_effect_type="userId",
+            feature_shard_id="userShard",
+            task=TaskType.LOGISTIC_REGRESSION,
+            proj_all=proj,
+            entity_keys=tuple(str(i) for i in range(e)),
+        ),
+    })
+    tables = CoefficientTables.from_game_model(model)
+    programs = ScorePrograms(tables, ladder=ShapeLadder((rung,)))
+    if not programs.use_kernel:
+        return None
+    reqs = [
+        (
+            {
+                "features": rng.normal(size=d).astype(np.float32),
+                "userShard": rng.normal(size=du).astype(np.float32),
+            },
+            {"userId": str(i % e)},
+        )
+        for i in range(rung)
+    ]
+    feats, codes, _ = programs.pack_requests(reqs)
+    # warm (the AOT ladder compiled at construction; this pays the
+    # first-dispatch transfer outside the measured window)
+    programs.score_padded(feats, codes, rung)
+    site = "serve_kernel/score"
+    t0 = time.perf_counter()
+    out = programs.score_padded(feats, codes, rung)
+    t1 = time.perf_counter()
+    info = sk.traced_sites().get(site)
+    if info is None:
+        return None
+    probe_site = "serve_kernel/probe"
+    ledger.register_program(probe_site, phase="serve", cost=info["cost"])
+    ledger.record_dispatch(
+        probe_site, t1 - t0, phase="serve", start=t0, end=t1)
+    return {
+        "program": probe_site,
+        "rung": rung,
+        "seconds": round(t1 - t0, 6),
+        "checksum": float(np.asarray(out).sum()),
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="photon profile", description=__doc__,
@@ -278,10 +373,12 @@ def main(argv=None) -> int:
     # fit seconds, or a dead fused-fit feed would hide behind them.
     fit_attr = ledger.attribution_since(mark, wall_seconds=fit_wall)
     _serve_pass(result, data)
-    # Kernel probe: where the segment-reduce kernel serves this backend,
-    # one real dispatch prices its census/roofline row into the report
-    # (the profile-smoke job forces the kernel and asserts the row).
+    # Kernel probes: where the segment-reduce / fused serve kernels
+    # serve this backend, one real dispatch each prices its census/
+    # roofline row into the report (the profile-smoke job forces the
+    # kernels and asserts the rows).
     kernel_probe = _kernel_probe()
+    serve_kernel_probe = _serve_kernel_probe()
     attribution = ledger.attribution_since(mark, wall_seconds=None)
 
     table = ledger.render_top_k(args.top)
@@ -329,6 +426,19 @@ def main(argv=None) -> int:
             failures.append(
                 "segment-reduce census row carries no priced roofline "
                 "(vs_roofline is None — analytic cost missing)")
+    if serve_kernel_probe is not None:
+        probe_rows = [
+            r for r in ledger.report()["rows"]
+            if r.get("program") == serve_kernel_probe["program"]
+        ]
+        if not probe_rows:
+            failures.append(
+                "serve kernel dispatched but its census row is missing "
+                "from the priced report")
+        elif probe_rows[0].get("vs_roofline") is None:
+            failures.append(
+                "serve-kernel census row carries no priced roofline "
+                "(vs_roofline is None — analytic cost missing)")
 
     if args.json:
         doc = {
@@ -341,6 +451,7 @@ def main(argv=None) -> int:
             },
             "overhead": overhead,
             "kernel_probe": kernel_probe,
+            "serve_kernel_probe": serve_kernel_probe,
             "failures": failures,
         }
         with open(args.json, "w") as f:

@@ -58,6 +58,7 @@ from photon_tpu.data.dataset import (
     SparseFeatures,
 )
 from photon_tpu.data.game_data import GameDataset
+from photon_tpu.ops import segment_reduce
 from photon_tpu.data.pipeline import (
     PIPELINE_STATS,
     bincount_chunked,
@@ -364,6 +365,13 @@ class RandomEffectDataset:
     # so per-fit bookkeeping never pulls from the device.
     block_codes_np: tuple = ()
     block_intercepts_np: tuple = ()
+    # Per-bucket (grad_mult, hess_mult) WINDOW bounds for the direct ELL
+    # gram route (ops/segment_reduce.ell_gram_supported documents the
+    # currency), or None per bucket when the route cannot engage there
+    # (small subspaces densify up front; over-budget pair passes).
+    # Empty for lazy datasets — their slabs never exist on the host, so
+    # there is nothing to bound at plan time.
+    block_gram_mults: tuple = ()
     # [n] bool host mask: rows kept into some training block (built from the
     # planner's rows_flat, so no device work is needed to derive it).
     covered_np: np.ndarray | None = None
@@ -1571,6 +1579,59 @@ def skeleton_random_effect_dataset(
     )
 
 
+def _gram_window_bounds(
+    bi: np.ndarray, bv: np.ndarray, sub_dim: int
+) -> tuple | None:
+    """HOST (grad_mult, hess_mult) window bounds for one bucket's ELL
+    slabs — the static coverage key of the direct gram route
+    (algorithm/random_effect._solve_direct_gram) — or None when that
+    route can never engage for this bucket.
+
+    Counts only NONZERO entries (the device side remaps zero products to
+    the drop segment, so device counts are always <= these), binned into
+    the kernel's output windows via ``segment_reduce.window_counts_np``.
+    A uniform per-segment bound would be useless: the intercept slot
+    co-occurs with every row of its entity, putting the per-SEGMENT
+    multiplicity at the row count while whole windows stay cheap.
+    Entity-axis PADDING (parallel/mesh) appends inert zero-weight
+    entities after these ids, so the bounds survive mesh sharding.
+    """
+    b, cap, k = bi.shape
+    s = int(sub_dim)
+    if (
+        s <= DENSE_SUB_DIM_MAX
+        and b * cap * k * s <= ONE_HOT_ELEMENT_BUDGET
+    ):
+        return None  # bucket densifies up front; the gram route is moot
+    if b * cap * k * k > segment_reduce.GRAM_ELEMENT_BUDGET:
+        return None  # pair pass over budget on device and host alike
+    nz = bv != 0.0
+    grad_counts = hess_counts = None
+    # Chunk over the entity axis: the pair-id tensor is
+    # [chunk, cap, k, k] int64, a bounded transient for any bucket size.
+    step = max(1, (1 << 22) // max(cap * k * k, 1))
+    for lo in range(0, b, step):
+        hi = min(lo + step, b)
+        ent = np.arange(lo, hi, dtype=np.int64)[:, None, None]
+        nzc = nz[lo:hi]
+        bic = bi[lo:hi].astype(np.int64)
+        gids = (ent * s + bic)[nzc]
+        gc = segment_reduce.window_counts_np(gids, b * s)
+        grad_counts = gc if grad_counts is None else grad_counts + gc
+        pair_nz = nzc[:, :, :, None] & nzc[:, :, None, :]
+        pids = (
+            ent[..., None] * (s * s)
+            + bic[:, :, :, None] * s
+            + bic[:, :, None, :]
+        )[pair_nz]
+        hc = segment_reduce.window_counts_np(pids, b * s * s)
+        hess_counts = hc if hess_counts is None else hess_counts + hc
+    return (
+        segment_reduce.window_bound_from_counts(grad_counts.max()),
+        segment_reduce.window_bound_from_counts(hess_counts.max()),
+    )
+
+
 def build_random_effect_dataset(
     game_data: GameDataset,
     config: RandomEffectDataConfiguration,
@@ -1738,6 +1799,7 @@ def build_random_effect_dataset(
 
     # ---- materialized layout (DualEll shards, introspection) -------------
     blocks = []
+    gram_mults_list = []
     for bh in bucket_host:
         members = bh["members"]
         b, cap = bh["brow"].shape
@@ -1754,6 +1816,10 @@ def build_random_effect_dataset(
         bv = np.zeros((b, cap, k), dtype=ell_val.dtype)
         bi[t_of, r_of] = ri
         bv[t_of, r_of] = rv
+        # Static coverage bounds for the direct ELL gram route (priced
+        # here, at plan time, like score_tail_mult below): None when
+        # this bucket can never take it.
+        gram_mults_list.append(_gram_window_bounds(bi, bv, s))
         bl = np.zeros((b, cap), dtype=labels_np.dtype)
         bo = np.zeros((b, cap), dtype=offsets_np.dtype)
         bw = np.zeros((b, cap), dtype=weights_np.dtype)
@@ -1818,6 +1884,7 @@ def build_random_effect_dataset(
         score_tail_mult=tail_mult,
         block_codes_np=tuple(bh["members"] for bh in bucket_host),
         block_intercepts_np=tuple(bh["intercepts"] for bh in bucket_host),
+        block_gram_mults=tuple(gram_mults_list),
         covered_np=covered_np,
     )
 

@@ -367,6 +367,161 @@ def scatter_add_rows(
     return z + out.astype(z.dtype)
 
 
+# Pair-product transient cap for the gram route: ``ell_gram_blocks``
+# materializes the [B, R, k, k] f32 pair products before the flat
+# reduce; past this many elements the transient (plus the argsort over
+# it) outweighs what skipping the dense [B, R, S] slab saves, and the
+# plan-time host pass that bounds window coverage stops being free.
+GRAM_ELEMENT_BUDGET = 1 << 26
+
+
+def window_counts_np(ids: np.ndarray, num_segments: int) -> np.ndarray:
+    """HOST: per-``_OUT_TILE``-window element counts for flat segment
+    ids — plan-time numpy bookkeeping for the gram route. The planner
+    (data/random_effect) accumulates these over entity chunks and feeds
+    the max through ``window_bound_from_counts`` to get the bound
+    ``ell_gram_supported`` consumes."""
+    return np.bincount(
+        ids // _OUT_TILE, minlength=-(-int(num_segments) // _OUT_TILE)
+    )
+
+
+def window_bound_from_counts(max_count) -> int:
+    """Convert a max per-window element count to the ``multiplicity``
+    currency of ``sorted_segment_sum`` (elements per window divided by
+    ``_OUT_TILE``, ceil, floored at 1): the kernel visits
+    ``_k_for(_OUT_TILE * bound)`` input tiles per window, which covers
+    exactly when no window holds more than ``_OUT_TILE * bound``
+    elements."""
+    return max(-(-int(max_count) // _OUT_TILE), 1)
+
+
+def ell_gram_supported(
+    b: int, r: int, k: int, sub_dim: int, *,
+    grad_mult: int, hess_mult: int,
+) -> bool:
+    """Whether the gram-route reduces (``ell_gram_blocks`` +
+    ``ell_segment_slots``) serve this ELL block shape on this backend.
+
+    ``grad_mult`` / ``hess_mult`` are HOST-computed WINDOW bounds
+    (data/random_effect.py ``block_gram_mults``): the max nonzero
+    elements landing in one ``_OUT_TILE``-segment output window,
+    divided (ceil) by ``_OUT_TILE`` — the same coverage currency
+    ``sorted_segment_sum`` sizes its visited-tile window with. A
+    uniform per-segment bound would be useless here: the intercept
+    slot co-occurs with every row, so per-SEGMENT multiplicity is the
+    row count, while whole windows stay cheap.
+    """
+    s = int(sub_dim)
+    m_pair = b * r * k * k
+    if m_pair > GRAM_ELEMENT_BUDGET:
+        return False
+    if _k_for(_OUT_TILE * max(int(grad_mult), 1)) > _MAX_K_TILES:
+        return False
+    if _k_for(_OUT_TILE * max(int(hess_mult), 1)) > _MAX_K_TILES:
+        return False
+    # Products are formed f32 regardless of the storage dtype.
+    return (
+        kernel_supported(m_pair, b * s * s, jnp.float32)
+        and kernel_supported(b * r * k, b * s, jnp.float32)
+    )
+
+
+def ell_segment_slots(
+    x_indices: Array,  # [B, R, k] int32 subspace slots
+    x_values: Array,  # [B, R, k] (f32 or bf16 storage)
+    row_weights: Array,  # [B, R] per-row scale (e.g. weighted targets)
+    sub_dim: int,
+    *,
+    multiplicity: int,
+    site: str = "segment_reduce/gram",
+) -> Array | None:
+    """Per-entity weighted slot totals straight from the ELL layout:
+    ``out[b, s] = sum_{r, j: idx[b,r,j] == s} row_weights[b,r] * v[b,r,j]``
+    as ONE flat sorted tiled reduce — the ``X^T (w*y)`` half of the
+    normal equations with no [B, R, S] densified slab in between.
+
+    Products are formed in f32 (the ELL payload is read once at storage
+    width, then upcast), and ZERO products are remapped to the drop
+    segment: the host-computed window bound counts only nonzero entries,
+    so padding lanes must not land in real segments. ``multiplicity`` is
+    the window bound described at ``ell_gram_supported``. Returns None
+    when the kernel does not serve this shape.
+    """
+    b, r, k = x_indices.shape
+    s = int(sub_dim)
+    n = b * s
+    m = b * r * k
+    if (
+        not kernel_supported(m, n, jnp.float32)
+        or _k_for(_OUT_TILE * max(int(multiplicity), 1)) > _MAX_K_TILES
+    ):
+        return None
+    vals = (
+        x_values.astype(jnp.float32)
+        * row_weights.astype(jnp.float32)[:, :, None]
+    ).reshape(-1)
+    ent = jnp.arange(b, dtype=jnp.int32)[:, None, None] * s
+    ids = (x_indices.astype(jnp.int32) + ent).reshape(-1)
+    ids = jnp.where(vals != 0.0, ids, n)
+    order = jnp.argsort(ids)
+    flat = sorted_segment_sum(
+        jnp.take(vals, order), jnp.take(ids, order), n,
+        multiplicity=multiplicity, site=site,
+    )
+    return flat.reshape(b, s)
+
+
+def ell_gram_blocks(
+    x_indices: Array,  # [B, R, k] int32 subspace slots
+    x_values: Array,  # [B, R, k] (f32 or bf16 storage)
+    weights: Array,  # [B, R] row weights (curvature)
+    sub_dim: int,
+    *,
+    multiplicity: int,
+    site: str = "segment_reduce/gram",
+) -> Array | None:
+    """Per-entity weighted gram matrices ``X^T diag(w) X`` straight from
+    the ELL layout, [B, S, S] f32: every pair product
+    ``w[b,r] * v[b,r,j] * v[b,r,l]`` lands in flat segment
+    ``b*S^2 + idx[b,r,j]*S + idx[b,r,l]`` and ONE sorted tiled reduce
+    aggregates the whole bucket's Hessians — the dense [B, R, S] slab
+    the direct solver previously needed never exists.
+
+    Same f32-product / zero-drop / window-bound conventions as
+    ``ell_segment_slots`` (the bound here is ``hess_mult``). Returns
+    None when the kernel does not serve this shape.
+    """
+    b, r, k = x_indices.shape
+    s = int(sub_dim)
+    n = b * s * s
+    m = b * r * k * k
+    if (
+        m > GRAM_ELEMENT_BUDGET
+        or not kernel_supported(m, n, jnp.float32)
+        or _k_for(_OUT_TILE * max(int(multiplicity), 1)) > _MAX_K_TILES
+    ):
+        return None
+    xf = x_values.astype(jnp.float32)
+    vals = (
+        weights.astype(jnp.float32)[:, :, None, None]
+        * xf[:, :, :, None]
+        * xf[:, :, None, :]
+    ).reshape(-1)
+    idx = x_indices.astype(jnp.int32)
+    ent = (
+        jnp.arange(b, dtype=jnp.int32)[:, None, None, None] * (s * s)
+    )
+    ids = (ent + idx[:, :, :, None] * s + idx[:, :, None, :]).reshape(-1)
+    ids = jnp.where(vals != 0.0, ids, n)
+    order = jnp.argsort(ids)
+    flat = sorted_segment_sum(
+        jnp.take(vals, order), jnp.take(ids, order), n,
+        multiplicity=multiplicity, site=site,
+    )
+    return flat.reshape(b, s, s)
+
+
 def densify_ell_blocks(
     x_indices: Array,  # [B, R, k] int32 subspace slots (dups sum)
     x_values: Array,  # [B, R, k]

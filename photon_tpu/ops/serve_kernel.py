@@ -1,0 +1,403 @@
+"""Fused serve-score TPU kernel (Pallas): one dispatch per rung.
+
+The AOT score ladder (serve/programs.py) lowers each coordinate's
+gather -> contract -> add as its own fusion chain inside the jitted
+program: per random coordinate, XLA materializes the gathered [B, S]
+coefficient rows and the [B, k, S] / [S, d] one-hot operands in HBM
+between chains, and the per-coordinate adds round-trip the [B] partial
+scores. This kernel scores an entire padded rung in ONE pallas_call:
+
+- the grid is ``(rung,)`` — each step owns one request row;
+- the per-request entity codes ride as a SCALAR-PREFETCHED [C, rung]
+  int32 array (``pltpu.PrefetchScalarGridSpec``), so each random
+  coordinate's [1, S] weight row and projector row are DMA'd straight
+  from the HBM-resident tables by the BlockSpec index maps
+  (``codes[c, i]``, clamped at 0) before the body runs — the gather
+  never materializes a [B, S] intermediate;
+- inside the body every contraction is a one-hot multiply-reduce in
+  VMEM with float32 accumulators; coordinate partials add in registers
+  and the [1, 1] score is written once. Cold rows (code -1) multiply
+  their random contribution by 0 — fixed-effect-only, the same
+  semantics as ``models/game._score_raw_dense`` / ``_score_raw_sparse``.
+
+Storage dtypes: f32 or bf16 tables (the serving precision policy);
+feature payloads are cast to the table dtype at the contraction and
+every reduction accumulates f32 — the ops/precision.py invariant, and
+the parity contract with the jit fallback (tests/test_serve_kernel.py).
+
+Scope and fallback mirror ops/segment_reduce.py: Mosaic lowering is
+TPU-only, so ``interpret_required()`` routes forced runs on other
+backends through ``interpret=True``; unforced non-TPU backends keep the
+jitted per-coordinate chain, which doubles as the parity oracle. The
+``PHOTON_SERVE_KERNEL`` flag (auto/force/off) picks the path ONCE at
+``ScorePrograms`` construction — tables stay traced operands either
+way, so values-only reloads re-enter the same executables.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+# Program contract (audited by `python -m photon_tpu.analysis
+# --semantic`): one ladder rung through the fused kernel is ONE program
+# — tables, features and the prefetched codes are traced operands; only
+# the rung batch and the model structure (shard widths, coordinate
+# count, sub_dims) are static and may mint a new executable. No host
+# callbacks, no f64: this kernel IS the steady-state request loop.
+PROGRAM_AUDIT = dict(
+    name="serve-kernel",
+    entry="ops.serve_kernel.fused_score",
+    builder="build_serve_kernel",
+    max_programs=1,
+    recompiles_on=("rung", "model_structure"),
+    hot_loop=True,
+)
+
+# Memory contract (`--memory`, ANALYSIS.md): the fused rung's live set
+# is the resident tables (weights at storage width + int32 projector +
+# fixed weights) plus the padded request payloads and the [rung] f32
+# output — NO gathered [rung, s] coefficient intermediate and no
+# [rung, k, s] one-hot operand, which is the kernel's memory story vs
+# the jit chain. Scaffold constant mirrors the serving audit.
+MEMORY_AUDIT = dict(
+    name="serve-kernel-memory",
+    entry="ops.serve_kernel.fused_score",
+    covers=("serve-kernel",),
+    builder="build_serve_kernel_memory",
+    budgets={
+        # Resident: [e, s] weights at storage width + [e, s] int32
+        # projector + [d] fixed weights (+ a fixed scaffold constant);
+        # per request row: the padded feature payloads (d dense + du
+        # shard columns), the prefetched code, and the f32 score. NO
+        # rung * s gathered-coefficient term — the kernel's gathers
+        # live in VMEM blocks, which is the whole point.
+        "serve_kernel_b*": (
+            "e * s * (wbytes + 4) + d * wbytes + 52 * wbytes"
+            " + rung * (d + du + s) * wbytes"
+        ),
+    },
+    tolerance=1.5,
+)
+
+# Tier-5 numerics contract (`--numerics`): the kernel traced over bf16
+# tables next to the jit fallback on the same fixture. One table
+# storage rounding per gathered coefficient + f32 accumulation per
+# reduced column; the one-hot contraction is a static single-axis VMEM
+# reduce per coordinate — no scatter family, so the determinism census
+# has nothing to declare.
+NUMERICS_AUDIT = dict(
+    name="serve-kernel-numerics",
+    entry="ops.serve_kernel.fused_score",
+    covers=("serve-kernel",),
+    builder="build_serve_kernel_numerics",
+    budgets={
+        # One bf16 storage rounding on the deepest path (feature cast
+        # at the contraction; the table sides are already storage
+        # width) + f32 accumulator rounding over the summed one-hot
+        # reduce lengths: the [s, d] random gather + the [s] row
+        # contraction + the [d] fixed contraction, plus the per-rung
+        # output accumulation.
+        "serve_kernel_b*": (
+            "u16 + u32 * (s * (d + du) + d + du + 2 * s + 4 * rung)"
+        ),
+    },
+    tolerance=1.5,
+)
+
+# Trace-time site registry (host-side), same shape as
+# ops/segment_reduce._TRACED_SITES: every kernel instantiation records
+# its static shape + analytic cost so cli.profile can register a priced
+# census row without the dispatch path touching the ledger. Keyed by
+# (site, rung, structure digest); ``traced_sites()`` aggregates per
+# site. Cleared between tests by the conftest reset.
+_TRACED_SITES: dict[tuple, dict] = {}
+
+
+def interpret_required() -> bool:
+    """True when pallas_call must run interpreted on this backend
+    (same contract as ops/segment_reduce.interpret_required)."""
+    return jax.default_backend() != "tpu"
+
+
+def kernel_supported(dtype) -> bool:
+    """Whether the fused kernel serves score dispatches on this backend.
+
+    ``PHOTON_SERVE_KERNEL``: ``auto`` (default — real TPU only),
+    ``force``/``on``/``1`` (every backend; non-TPU runs interpreted —
+    slow, for parity tests and the profile probe), ``off``/``0``
+    (always the jitted per-coordinate chain).
+    """
+    flag = os.environ.get("PHOTON_SERVE_KERNEL", "auto").lower()
+    if flag in ("0", "off", "false"):
+        return False
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16)):
+        return False
+    if flag in ("1", "on", "force"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _record_site(site: str, rung: int, fe_dims, re_dims, dtype) -> None:
+    """Host bookkeeping at trace time (once per rung trace, never per
+    dispatch): analytic cost of one fused dispatch in the costmodel's
+    counter vocabulary. ``fe_dims`` is [(kind, width, k)] per fixed
+    coordinate; ``re_dims`` [(kind, width, k, s)] per random one."""
+    esize = jnp.dtype(dtype).itemsize
+    flops = 0.0
+    hbm = float(rung) * 4.0  # the [rung] f32 output
+    for kind, d, k in fe_dims:
+        hbm += d * esize  # the resident weight vector, read once
+        if kind == "dense":
+            flops += 2.0 * rung * d
+            hbm += rung * d * 4.0
+        else:
+            flops += 2.0 * rung * k * d
+            hbm += rung * k * 8.0
+    for kind, d, k, s in re_dims:
+        # One [1, s] weight + projector row gathered per request.
+        hbm += rung * s * (esize + 4.0)
+        if kind == "dense":
+            flops += 2.0 * rung * (s * d + s)
+            hbm += rung * d * 4.0
+        else:
+            flops += 2.0 * rung * (k * s + s)
+            hbm += rung * k * 8.0
+    _TRACED_SITES[(site, int(rung), tuple(fe_dims), tuple(re_dims),
+                   str(jnp.dtype(dtype)))] = {
+        "rung": int(rung),
+        "dtype": str(jnp.dtype(dtype)),
+        "cost": {
+            "flops": flops,
+            "hbm_bytes": hbm,
+            "transcendentals": 0.0,
+        },
+    }
+
+
+def traced_sites() -> dict[str, dict]:
+    """Per-SITE aggregate of every fused-score instantiation traced so
+    far (host bookkeeping for the cost ledger / cli.profile census): a
+    site traced at several rungs prices the SUM of its instances'
+    analytic costs."""
+    out: dict[str, dict] = {}
+    for (site, *_rest), info in _TRACED_SITES.items():
+        agg = out.get(site)
+        if agg is None:
+            agg = out[site] = {
+                "instances": 0,
+                "rungs": 0,
+                "cost": {"flops": 0.0, "hbm_bytes": 0.0,
+                         "transcendentals": 0.0},
+            }
+        agg["instances"] += 1
+        agg["rungs"] += info["rung"]
+        for key in ("flops", "hbm_bytes", "transcendentals"):
+            agg["cost"][key] += info["cost"][key]
+    return out
+
+
+def _make_kernel(fe_ops, re_ops):
+    """Kernel body closure over the STATIC coordinate walk.
+
+    ``fe_ops``: [(kind, shard_ref_slots, w_slot, wdtype)] per fixed
+    coordinate; ``re_ops``: [(kind, shard_ref_slots, w_slot, code_row,
+    wdtype)] per random one. Slot numbers index the positional operand
+    refs; the scalar-prefetched codes ref comes first.
+    """
+
+    def kernel(codes_ref, *refs):
+        out_ref = refs[-1]
+        i = pl.program_id(0)
+        acc = jnp.zeros((1, 1), jnp.float32)
+        for kind, shard, w_slot, wdtype in fe_ops:
+            w = refs[w_slot][...]  # [1, d]
+            if kind == "dense":
+                x = refs[shard[0]][...].astype(wdtype)  # [1, d]
+                acc += jnp.sum(
+                    (x * w).astype(jnp.float32), axis=1, keepdims=True
+                )
+            else:
+                idx = refs[shard[0]][...]  # [1, k] int32
+                val = refs[shard[1]][...]  # [1, k]
+                k = idx.shape[1]
+                d = w.shape[1]
+                onehot = (
+                    idx[0][:, None]
+                    == jax.lax.broadcasted_iota(jnp.int32, (k, d), 1)
+                ).astype(jnp.float32)
+                # One-hot gather is exact: f32 sum of one bf16 value.
+                gathered = jnp.sum(
+                    onehot * w.astype(jnp.float32), axis=1
+                ).astype(wdtype)
+                acc += jnp.sum(
+                    (val[0].astype(wdtype) * gathered).astype(
+                        jnp.float32
+                    ),
+                )[None, None]
+        for kind, shard, w_slot, code_row, wdtype in re_ops:
+            w = refs[w_slot][...]       # [1, s] gathered table row
+            proj = refs[w_slot + 1][...]  # [1, s] int32 projector row
+            s = w.shape[1]
+            # Cold / padding rows (code -1) contribute zero — the
+            # fixed-effect-only fallback of the jit chain.
+            known = (codes_ref[code_row, i] >= 0).astype(jnp.float32)
+            if kind == "dense":
+                x = refs[shard[0]][...]  # [1, d] f32 payload
+                d = x.shape[1]
+                # proj -1 pads match no feature id: the spill-drop of
+                # _score_raw_dense's scatter.
+                onehot = (
+                    proj[0][:, None]
+                    == jax.lax.broadcasted_iota(jnp.int32, (s, d), 1)
+                ).astype(jnp.float32)
+                # One-hot gather is exact (distinct projector slots:
+                # one term per row), so rounding AFTER it equals the
+                # jit chain's x.astype(w.dtype) — one storage rounding,
+                # no f32->bf16->f32 round-trip in the cast graph.
+                xg = jnp.sum(
+                    onehot * x.astype(jnp.float32)[0][None, :], axis=1
+                ).astype(wdtype)
+                z = jnp.sum(
+                    (w[0] * xg).astype(jnp.float32)
+                )
+            else:
+                idx = refs[shard[0]][...]  # [1, k] int32
+                val = refs[shard[1]][...]  # [1, k]
+                k = idx.shape[1]
+                onehot = (
+                    idx[0][:, None] == proj[0][None, :]
+                ).astype(jnp.float32)  # [k, s]; duplicates sum
+                contrib = jnp.sum(
+                    val[0].astype(jnp.float32)[:, None] * onehot, axis=0
+                ).astype(wdtype)  # storage rounding, like_storage
+                z = jnp.sum(
+                    (contrib.astype(jnp.float32))
+                    * w[0].astype(jnp.float32)
+                )
+            acc += (known * z)[None, None]
+        out_ref[...] = acc
+
+    return kernel
+
+
+def fused_score(
+    fe_ws,
+    re_ws,
+    re_projs,
+    feats,
+    codes,
+    *,
+    spec_kinds: tuple[str, ...],
+    fe_feat: tuple[int, ...],
+    re_feat: tuple[int, ...],
+    interpret: bool | None = None,
+    site: str = "serve_kernel/score",
+) -> Array:
+    """Score one padded rung in a single fused kernel dispatch.
+
+    Operand layout is EXACTLY ``ScorePrograms.score_fn``'s: per-shard
+    feature leaves in ``shard_order`` position (``spec_kinds``), fixed
+    weight vectors + random (weights, projector) tables, and one [rung]
+    int32 code vector per random coordinate. Returns [rung] float32.
+    Call under an outer jit — the pallas_call is built at trace time
+    from the static model structure.
+    """
+    if not feats:
+        raise ValueError("fused_score needs at least one feature shard")
+    leaf = feats[0]
+    rung = int(
+        (leaf if isinstance(leaf, jax.Array) or hasattr(leaf, "shape")
+         else leaf[0]).shape[0]
+    )
+    n_codes = len(re_ws)
+    codes_arr = (
+        jnp.stack([c.astype(jnp.int32) for c in codes])
+        if n_codes
+        else jnp.zeros((1, rung), jnp.int32)
+    )
+
+    operands: list = []
+    in_specs: list = []
+    shard_slots: dict[int, tuple[int, ...]] = {}
+
+    def row_spec(width: int):
+        return pl.BlockSpec((1, width), lambda i, s: (i, 0))
+
+    for si, kind in enumerate(spec_kinds):
+        if kind == "dense":
+            x = feats[si]
+            shard_slots[si] = (len(operands),)
+            operands.append(x)
+            in_specs.append(row_spec(x.shape[1]))
+        else:
+            idx, val = feats[si]
+            shard_slots[si] = (len(operands), len(operands) + 1)
+            operands += [idx.astype(jnp.int32), val]
+            in_specs += [row_spec(idx.shape[1]), row_spec(val.shape[1])]
+
+    fe_ops = []
+    fe_dims = []
+    for w, fi in zip(fe_ws, fe_feat):
+        fe_ops.append(
+            (spec_kinds[fi], shard_slots[fi], len(operands),
+             jnp.dtype(w.dtype))
+        )
+        d = int(w.shape[0])
+        kk = 0 if spec_kinds[fi] == "dense" else int(
+            feats[fi][0].shape[1]
+        )
+        fe_dims.append((spec_kinds[fi], d, kk))
+        operands.append(w.reshape(1, d))
+        in_specs.append(pl.BlockSpec((1, d), lambda i, s: (0, 0)))
+
+    re_ops = []
+    re_dims = []
+    wdtype = jnp.dtype(fe_ws[0].dtype) if fe_ws else None
+    for ci, (w, proj, fi) in enumerate(zip(re_ws, re_projs, re_feat)):
+        sdim = int(w.shape[1])
+        re_ops.append(
+            (spec_kinds[fi], shard_slots[fi], len(operands), ci,
+             jnp.dtype(w.dtype))
+        )
+        wdtype = jnp.dtype(w.dtype)
+        if spec_kinds[fi] == "dense":
+            re_dims.append(("dense", int(feats[fi].shape[1]), 0, sdim))
+        else:
+            re_dims.append(
+                ("sparse", 0, int(feats[fi][0].shape[1]), sdim)
+            )
+
+        def table_row(i, s, c=ci):
+            # Codes are scalar-prefetched: the DMA for this request's
+            # table row is issued from the index map, before the body.
+            return (jnp.maximum(s[c, i], 0), 0)
+
+        operands.append(w)
+        in_specs.append(pl.BlockSpec((1, sdim), table_row))
+        operands.append(proj.astype(jnp.int32))
+        in_specs.append(pl.BlockSpec((1, sdim), table_row))
+
+    _record_site(site, rung, fe_dims, re_dims, wdtype or jnp.float32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(rung,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1), lambda i, s: (i, 0)),
+    )
+    out = pl.pallas_call(
+        _make_kernel(tuple(fe_ops), tuple(re_ops)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rung, 1), jnp.float32),
+        interpret=(
+            interpret_required() if interpret is None else interpret
+        ),
+    )(codes_arr, *operands)
+    return out[:, 0]

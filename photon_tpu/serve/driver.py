@@ -321,6 +321,22 @@ def drive(
     out["dispatch_errors"] = (
         qstats["dispatch_errors"] - warm_stats["dispatch_errors"]
     )
+    # Staging pipeline deltas over the measured window: how much of
+    # the host pad/stack time the pipelined worker hid behind in-flight
+    # device dispatch (0.0 on the serial worker, None if no pack work
+    # happened at all — e.g. a drive short enough to batch nothing).
+    staged = qstats["staged_batches"] - warm_stats["staged_batches"]
+    stage_s = (
+        qstats["staging_seconds"] - warm_stats["staging_seconds"]
+    )
+    stage_ov = (
+        qstats["staging_overlapped_seconds"]
+        - warm_stats["staging_overlapped_seconds"]
+    )
+    out["staged_batches"] = staged
+    out["staging_overlap_fraction"] = (
+        round(stage_ov / stage_s, 4) if stage_s > 0 else None
+    )
     # Live-monitoring surfaces (photon_tpu.obs.monitor): the sliding
     # window's p50/p99 (warmup ages out of the ring; whole-run
     # percentiles above cannot), the SLO burn report, and the

@@ -223,6 +223,18 @@ def specs_from_dataset(data) -> dict[str, FeatureSpec]:
     return specs
 
 
+@dataclasses.dataclass(frozen=True)
+class _Inflight:
+    """One dispatched-but-unfetched rung: the device value, its rung,
+    the caller's live row count, and the dispatch timestamp the ledger
+    window opens at."""
+
+    out: object
+    batch: int
+    n: int
+    t0: float
+
+
 class ScorePrograms:
     """The compiled score ladder for one model structure.
 
@@ -297,6 +309,16 @@ class ScorePrograms:
         spec_kinds = tuple(
             self.specs[s].kind for s in self.shard_order
         )
+        # Fused-kernel engagement is decided ONCE, at construction (the
+        # PHOTON_SERVE_KERNEL auto/force/off gate + table dtype): the
+        # choice is baked into the traced program, so the AOT ladder,
+        # the zero-recompile contract and values-only reloads behave
+        # identically on both paths — tables stay traced operands.
+        from photon_tpu.ops import serve_kernel as serve_kernel_mod
+
+        self.use_kernel = serve_kernel_mod.kernel_supported(
+            str(w0.dtype)
+        )
 
         def score_fn(fe_ws, re_ws, re_projs, feats, codes):
             import jax.numpy as jnp
@@ -307,6 +329,16 @@ class ScorePrograms:
             )
             from photon_tpu.ops import precision as precision_mod
 
+            if self.use_kernel:
+                # One fusion-boundary-free dispatch for the whole rung
+                # (ops/serve_kernel.py); the per-coordinate chain below
+                # stays as the fallback and the parity reference.
+                return serve_kernel_mod.fused_score(
+                    fe_ws, re_ws, re_projs, feats, codes,
+                    spec_kinds=spec_kinds,
+                    fe_feat=fe_feat,
+                    re_feat=re_feat,
+                )
             total = None
             for w, fi in zip(fe_ws, fe_feat):
                 if spec_kinds[fi] == "dense":
@@ -416,17 +448,20 @@ class ScorePrograms:
 
     # -- dispatch ---------------------------------------------------------
 
-    def score_padded(self, feats: dict, codes: dict, n: int) -> np.ndarray:
-        """Score ``n`` requests already stacked per shard/coordinate.
+    def dispatch_padded(self, feats: dict, codes: dict, n: int):
+        """Dispatch ``n`` stacked requests WITHOUT syncing: returns an
+        in-flight handle whose device value ``fetch_padded`` pulls.
 
-        ``feats[shard]`` is the spec's stacked leaf at some rung batch;
-        ``codes[coordinate]`` the matching [rung] int32 row-code vector
-        for that random-effect coordinate's OWN table. Returns the
-        first ``n`` scores as numpy (the fetch is the one host sync of
-        the request path).
+        The split exists for the queue's double-buffered staging: batch
+        k+1's host pack runs while batch k is in flight, and the
+        ledger's measured device window must exclude that overlapped
+        host time (``fetch_padded(exclude_seconds=...)``) or staging
+        would silently inflate ``vs_roofline`` on the serve rows.
+        Operand validation and assembly happen HERE, before the timing
+        window opens.
         """
         if not feats and not codes:
-            raise ValueError("score_padded needs at least one operand")
+            raise ValueError("score dispatch needs at least one operand")
         some = next(iter(feats.values())) if feats else None
         batch = (
             some.shape[0]
@@ -445,25 +480,48 @@ class ScorePrograms:
         c = tuple(
             np.asarray(codes[nm], dtype=np.int32) for nm in self._re_names
         )
+        t0 = time.perf_counter()
+        out = self._compiled[batch](fe_ws, re_ws, re_projs, f, c)
+        self.stats["dispatches"][batch] += 1
+        return _Inflight(out=out, batch=batch, n=n, t0=t0)
+
+    def fetch_padded(
+        self, handle: "_Inflight", *, exclude_seconds: float = 0.0
+    ) -> np.ndarray:
+        """Block on an in-flight dispatch; returns the first ``n``
+        scores as numpy (the fetch is the one host sync of the request
+        path).
+
+        ``exclude_seconds`` is host time the CALLER spent between
+        dispatch and fetch on work that was overlapped with the device
+        (the queue's staging pack): it is subtracted from the ledger's
+        measured window so the booked seconds stay device execution,
+        not an enqueue-to-fetch wall span.
+        """
+        scores = np.asarray(handle.out)
+        t1 = time.perf_counter()
         from photon_tpu.obs import ledger
 
         if ledger.enabled():
-            # dispatch -> host fetch is the rung's measured window (the
-            # asarray pull is the request path's one sync, so the
-            # window is real execution, not an enqueue stamp).
-            t0 = time.perf_counter()
-            out = self._compiled[batch](fe_ws, re_ws, re_projs, f, c)
-            scores = np.asarray(out)
-            t1 = time.perf_counter()
-            ledger.record_dispatch(
-                f"serve/score@{batch}", t1 - t0, phase="serve",
-                start=t0, end=t1,
+            seconds = max(
+                (t1 - handle.t0) - max(exclude_seconds, 0.0), 0.0
             )
-        else:
-            out = self._compiled[batch](fe_ws, re_ws, re_projs, f, c)
-            scores = np.asarray(out)
-        self.stats["dispatches"][batch] += 1
-        return scores[:n]
+            ledger.record_dispatch(
+                f"serve/score@{handle.batch}", seconds, phase="serve",
+                start=handle.t0, end=t1,
+            )
+        return scores[: handle.n]
+
+    def score_padded(self, feats: dict, codes: dict, n: int) -> np.ndarray:
+        """Score ``n`` requests already stacked per shard/coordinate.
+
+        ``feats[shard]`` is the spec's stacked leaf at some rung batch;
+        ``codes[coordinate]`` the matching [rung] int32 row-code vector
+        for that random-effect coordinate's OWN table. Serial
+        dispatch + fetch (the batch-scoring path and the fallback for
+        duck-typed program objects without the split API).
+        """
+        return self.fetch_padded(self.dispatch_padded(feats, codes, n))
 
     def pack_requests(
         self, requests: list[tuple[dict, dict]]

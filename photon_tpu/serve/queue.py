@@ -98,16 +98,23 @@ logger = logging.getLogger(__name__)
 # --concurrency`). The threading model is single-consumer: ONE worker
 # thread pops, pads, dispatches, and scatters; any number of producer
 # threads push. `_cond` (a Condition, which is also the mutex) guards
-# the pending deque, the closed flag, the stats dict, and the degraded-
-# mode state (breaker open/failure-streak, the deadline-scan latch);
-# the worker snapshots a batch UNDER the lock and dispatches OUTSIDE
-# it, so producers never queue behind an XLA execution — and every
-# future resolution (results, errors, deadline expiry, breaker drain,
-# shutdown strand) also runs OUTSIDE the lock, because resolution runs
-# user callbacks. Futures are created here (not executor-submitted)
-# and every one is resolved — by the batch's results, by the batch's
-# exception, by deadline expiry, by the breaker drain, or by close()'s
-# drain/timeout — so no waiter can hang on a dropped future.
+# the pending deque, the closed flag, the stats dict, the degraded-
+# mode state (breaker open/failure-streak, the deadline-scan latch),
+# and the double-buffer staging slot: `_staged` holds the NEXT batch,
+# popped and host-packed by `_stage_next` while the previous batch's
+# dispatch is in flight on the device (the PR 18 pipelined worker).
+# The slot is only ever filled and emptied on the one worker thread —
+# the lock covers its visibility to the breaker drain and to quiesce —
+# so the single-consumer invariant is unchanged. The worker snapshots
+# a batch UNDER the lock and dispatches OUTSIDE it, so producers never
+# queue behind an XLA execution — and every future resolution
+# (results, errors, deadline expiry, breaker drain, shutdown strand)
+# also runs OUTSIDE the lock, because resolution runs user callbacks.
+# Futures are created here (not executor-submitted) and every one is
+# resolved — by the batch's results, by the batch's exception, by
+# deadline expiry, by the breaker drain (which drains the staged batch
+# alongside the pending deque), or by close()'s drain/timeout — so no
+# waiter can hang on a dropped future.
 CONCURRENCY_AUDIT = dict(
     name="serve-queue",
     locks={
@@ -122,6 +129,7 @@ CONCURRENCY_AUDIT = dict(
             "MicroBatchQueue._close_stranded",
             "MicroBatchQueue._paused",
             "MicroBatchQueue._dispatching",
+            "MicroBatchQueue._staged",
             "MicroBatchQueue.programs",
             "MicroBatchQueue._re_types",
             "MicroBatchQueue.hotness",
@@ -136,18 +144,28 @@ CONCURRENCY_AUDIT = dict(
     thread_entries=(
         "MicroBatchQueue._worker",
         "MicroBatchQueue._dispatch",
+        "MicroBatchQueue._stage_next",
+        "MicroBatchQueue._pop_staged",
     ),
     jax_dispatch_ok={
         "_worker": "the worker loop itself only pops/waits/expires; "
         "all device work is in _dispatch (declared below)",
         "_dispatch": "dispatches PRE-COMPILED AOT executables only "
-        "(ScorePrograms.score_padded) — no tracing, no compilation can "
-        "occur on this thread (the ladder is compiled at construction "
-        "on the caller's thread and score_padded raises on an "
-        "un-compiled rung); the single worker thread serializes every "
-        "dispatch (the transient-retry loop re-enters the same "
-        "executables with the same operands), and the np.asarray fetch "
-        "is the request path's one intended host sync",
+        "(ScorePrograms.dispatch_padded / score_padded) — no tracing, "
+        "no compilation can occur on this thread (the ladder is "
+        "compiled at construction on the caller's thread and the "
+        "dispatch raises on an un-compiled rung); the single worker "
+        "thread serializes every dispatch (the transient-retry loop "
+        "re-enters the same executables with the same operands), and "
+        "the fetch_padded/np.asarray fetch is the request path's one "
+        "intended host sync",
+        "_stage_next": "host work only: pops the next batch under "
+        "_cond and packs it with ScorePrograms.pack_requests (pure "
+        "numpy pad/stack/vocab lookup — no jax entry point); the "
+        "device work it overlaps is the PREVIOUS batch's "
+        "already-dispatched executable",
+        "_pop_staged": "pops/waits under _cond only; the staged "
+        "batch's device work happens in _dispatch",
     },
 )
 
@@ -277,6 +295,25 @@ class _Future:
         return self._exc
 
 
+class _Staged:
+    """One double-buffered batch: popped from the pending deque and
+    host-packed (pad/stack/code resolution) by ``_stage_next`` while
+    the PREVIOUS batch's dispatch is still in flight on the device.
+    ``programs`` pins the generation the operands were packed against:
+    a structure reload that adopts new programs between stage and
+    dispatch invalidates ``packed`` (codes resolve against the OLD
+    vocabulary), so ``_dispatch`` re-packs from ``requests`` whenever
+    the identity check fails. A values-only reload keeps the programs
+    object (tables swap in place) and the packed operands stay valid."""
+
+    __slots__ = ("requests", "packed", "programs")
+
+    def __init__(self, requests, packed, programs):
+        self.requests = requests
+        self.packed = packed
+        self.programs = programs
+
+
 # Dispatch retry default: two quick re-attempts. A transient dispatch
 # failure clears in milliseconds or not at all; long backoff would just
 # stack linger on every queued request behind the batch.
@@ -308,6 +345,7 @@ class MicroBatchQueue:
         shed_watermark: int | None = None,
         breaker_threshold: int | None = None,
         dispatch_retry: "_retry.RetryPolicy | None" = _DISPATCH_RETRY,
+        pipeline_staging: bool = True,
         close_timeout_s: float | None = None,
         slo=None,
         latency_window_s: float = 10.0,
@@ -333,6 +371,13 @@ class MicroBatchQueue:
             else max(int(breaker_threshold), 1)
         )
         self.dispatch_retry = dispatch_retry
+        # Double-buffered staging (PR 18): while batch k's dispatch is
+        # in flight, the worker pops + host-packs batch k+1 into
+        # `_staged` so pad/stack/code-resolution time overlaps the
+        # device round trip instead of serializing with it. False
+        # restores the strictly serial worker (the byte-identical
+        # parity reference for the pipelined path).
+        self.pipeline_staging = bool(pipeline_staging)
         # Bounds the context-manager exit (``with`` blocks call close()
         # with no argument, which would otherwise join a wedged
         # dispatch forever).
@@ -349,6 +394,10 @@ class MicroBatchQueue:
         # the quiescer can wait out an in-flight batch.
         self._paused = False
         self._dispatching = False
+        # Staging hand-off slot: filled by _stage_next (worker thread,
+        # while the previous dispatch is in flight), emptied by
+        # _pop_staged / the breaker drain. Guarded by _cond.
+        self._staged: _Staged | None = None
         # Latched on the first deadline-bearing submit so the worker's
         # expiry scan stays off the clean path entirely.
         self._has_deadlines = default_deadline_s is not None
@@ -366,6 +415,15 @@ class MicroBatchQueue:
             "breaker_trips": 0,
             "breaker_rejected": 0,
             "shutdown_stranded": 0,
+            # Staging pipeline accounting: staged_batches counts
+            # batches popped + packed AHEAD of their dispatch;
+            # staging_seconds is ALL host pack time (staged or not),
+            # staging_overlapped_seconds the part hidden behind an
+            # in-flight device dispatch. overlap/total is the
+            # `staging_overlap_fraction` surfaced on /metrics.
+            "staged_batches": 0,
+            "staging_seconds": 0.0,
+            "staging_overlapped_seconds": 0.0,
         }
         # Live-monitoring surfaces (photon_tpu.obs.monitor; PR 9).
         # Per-COORDINATE cold/lookups counters (the global
@@ -665,6 +723,18 @@ class MicroBatchQueue:
             if snap["entity_lookups"]
             else None
         )
+        # Fraction of host pack (pad/stack/code-resolution) time that
+        # the pipelined worker hid behind an in-flight device dispatch.
+        # 0 on the serial worker; None before any batch packed.
+        snap["staging_overlap_fraction"] = (
+            round(
+                snap["staging_overlapped_seconds"]
+                / snap["staging_seconds"],
+                4,
+            )
+            if snap["staging_seconds"] > 0
+            else None
+        )
         return snap
 
     def health(self) -> dict:
@@ -689,7 +759,18 @@ class MicroBatchQueue:
                 "breaker_trips": self._stats["breaker_trips"],
                 "breaker_rejected": self._stats["breaker_rejected"],
                 "shutdown_stranded": self._stats["shutdown_stranded"],
+                "staged_batches": self._stats["staged_batches"],
+                "staging_overlap_fraction": (
+                    round(
+                        self._stats["staging_overlapped_seconds"]
+                        / self._stats["staging_seconds"],
+                        4,
+                    )
+                    if self._stats["staging_seconds"] > 0
+                    else None
+                ),
             }
+        snap["pipeline_staging"] = self.pipeline_staging
         snap["max_queue"] = self.max_queue
         snap["shed_watermark"] = self.shed_watermark
         snap["breaker_threshold"] = self.breaker_threshold
@@ -762,6 +843,25 @@ class MicroBatchQueue:
                 "serve_queue_requests_total", "counter",
                 "requests accepted by the queue",
                 [("", {}, float(stats["requests"]))],
+            ),
+            monitor.family(
+                "serve_staging_overlap_fraction", "gauge",
+                "fraction of host pad/stack time overlapped with "
+                "in-flight device dispatch by the pipelined worker",
+                [(
+                    "", {},
+                    (
+                        stats["staging_overlapped_seconds"]
+                        / stats["staging_seconds"]
+                    )
+                    if stats["staging_seconds"] > 0
+                    else 0.0,
+                )],
+            ),
+            monitor.family(
+                "serve_staged_batches_total", "counter",
+                "batches popped and host-packed ahead of dispatch",
+                [("", {}, float(stats["staged_batches"]))],
             ),
             monitor.family(
                 "serve_queue_events_total", "counter",
@@ -956,10 +1056,139 @@ class MicroBatchQueue:
                     )
                 self._cond.wait()
 
+    def _resolve_expired(self, expired: list[_Request]) -> None:
+        """Fail a round's deadline-expired requests (worker thread,
+        OUTSIDE the lock — resolution runs user callbacks). Shared by
+        the serial take path and the staging pre-pop."""
+        from photon_tpu import obs
+
+        if not expired:
+            return
+        exc = DeadlineExceededError(
+            "request deadline expired while queued; failed "
+            "fast before dispatch")
+        for r in expired:
+            r.future.set_exception(exc)
+            _record_request(r, "expired")
+        if self.slo_tracker is not None:
+            self.slo_tracker.observe_errors(len(expired))
+        if obs.enabled():
+            obs.REGISTRY.counter(
+                "serve_deadline_expired_total"
+            ).inc(len(expired))
+
+    def _pop_staged(self) -> "_Staged | None":
+        """Claim the staged batch, if any (worker thread). Parks while
+        quiesced — same gate as ``_take_batch`` — so a staged batch can
+        never dispatch inside a reload's swap window; ``_dispatching``
+        flips True under the SAME lock hold that claims the batch, so
+        quiesce waits out a claimed-but-not-yet-dispatched batch
+        exactly as it waits out an in-flight one."""
+        with self._cond:
+            while self._paused and not self._closed:
+                self._cond.wait()
+            staged = self._staged
+            if staged is None:
+                return None
+            self._staged = None
+            self._dispatching = True
+            self._cond.notify_all()
+            return staged
+
+    def _stage_next(self) -> float:
+        """Pop + host-pack the NEXT batch while the current batch's
+        dispatch is in flight (called from ``_dispatch`` on the worker
+        thread, after ``dispatch_padded`` and before the fetch).
+        Returns the seconds of pack work overlapped with the device —
+        ``fetch_padded`` subtracts them from its ledger window so the
+        overlap cannot inflate the serve rows' vs_roofline. No-ops
+        (returns 0.0) when a staged batch already exists (a dispatch
+        retry re-entered), when quiesced (the swap window must not see
+        popped-but-undispatched requests pile up), or when nothing is
+        pending. Pops with the same bookkeeping as ``_take_batch`` —
+        expiry scan first, batches/batched_requests counters, take_ts
+        stamps — but never lingers: the staging pop only fires when the
+        device is already busy, so waiting for a fuller batch would
+        waste exactly the overlap window this path exists to use."""
+        from photon_tpu import obs
+
+        with self._cond:
+            if self._staged is not None or self._paused:
+                return 0.0
+            expired = self._expire_locked()
+            # Pop only what the flush policy would already release — a
+            # full batch, a head request whose linger lapsed (it has
+            # been waiting at least as long as _take_batch would have
+            # let it), or a closing queue's drain. Anything younger
+            # keeps accumulating toward a fuller batch; the worker
+            # falls back to the lingering _take_batch after the fetch,
+            # so no request waits longer than the serial policy allows.
+            flush = bool(self._pending) and (
+                len(self._pending) >= self.max_batch
+                or self._closed
+                or (
+                    self._pending[0].enqueued_at + self.max_linger_s
+                    <= time.perf_counter()
+                )
+            )
+            reqs = (
+                [
+                    self._pending.popleft()
+                    for _ in range(
+                        min(len(self._pending), self.max_batch)
+                    )
+                ]
+                if flush
+                else []
+            )
+            if reqs:
+                self._stats["batches"] += 1
+                self._stats["batched_requests"] += len(reqs)
+                self._stats["staged_batches"] += 1
+                if obs.enabled():
+                    now = time.perf_counter()
+                    for r in reqs:
+                        r.take_ts = now
+                self._cond.notify_all()  # space freed: wake producers
+        self._resolve_expired(expired)
+        if not reqs:
+            return 0.0
+        t0 = time.perf_counter()
+        try:
+            packed = self.programs.pack_requests(
+                [(r.features, r.entity_ids) for r in reqs]
+            )
+        except Exception:  # noqa: BLE001 — staging is an optimization:
+            # a pack failure here (malformed request) must surface on
+            # the DISPATCH path where the retry/breaker machinery and
+            # the batch's futures handle it, not kill the in-flight
+            # batch's fetch. _dispatch re-packs when packed is None.
+            packed = None
+        dt = time.perf_counter() - t0
+        with self._cond:
+            self._staged = _Staged(reqs, packed, self.programs)
+            self._stats["staging_seconds"] += dt
+            self._stats["staging_overlapped_seconds"] += dt
+            self._cond.notify_all()
+        return dt
+
     def _worker(self) -> None:
         from photon_tpu import obs
 
         while True:
+            # A staged batch (popped + packed while the previous
+            # dispatch was in flight) goes first: its requests are
+            # already off the pending deque, so _take_batch cannot see
+            # them — and close() must drain them before the None exit.
+            staged = self._pop_staged()
+            if staged is not None:
+                try:
+                    self._dispatch(staged.requests, staged=staged)
+                finally:
+                    with self._cond:
+                        self._dispatching = False
+                        self._cond.notify_all()
+                continue
             # depth/breaker ride out of the lock hold _take_batch
             # already has — no second _cond acquisition per wakeup.
             batch, expired, depth, breaker = self._take_batch()
@@ -973,19 +1202,7 @@ class MicroBatchQueue:
                 obs.REGISTRY.gauge("serve_breaker_open").set(
                     float(breaker)
                 )
-            if expired:
-                exc = DeadlineExceededError(
-                    "request deadline expired while queued; failed "
-                    "fast before dispatch")
-                for r in expired:
-                    r.future.set_exception(exc)
-                    _record_request(r, "expired")
-                if self.slo_tracker is not None:
-                    self.slo_tracker.observe_errors(len(expired))
-                if obs.enabled():
-                    obs.REGISTRY.counter(
-                        "serve_deadline_expired_total"
-                    ).inc(len(expired))
+            self._resolve_expired(expired)
             if batch is None:
                 return
             if batch:
@@ -996,12 +1213,21 @@ class MicroBatchQueue:
                         self._dispatching = False
                         self._cond.notify_all()
 
-    def _dispatch(self, batch: list[_Request]) -> None:
+    def _dispatch(self, batch: list[_Request],
+                  staged: "_Staged | None" = None) -> None:
         """Pad, score, scatter — outside the lock (producers keep
         queuing while XLA runs). Runs on the worker thread only.
-        Transient failures retry with backoff (``dispatch_retry``);
-        anything else fans out to THIS batch's futures and feeds the
-        circuit breaker's consecutive-failure count."""
+        ``staged`` carries a batch ``_stage_next`` already host-packed
+        during the previous dispatch; its operands are reused when the
+        program generation still matches, re-packed otherwise (a
+        structure reload swapped the vocabulary out from under them).
+        On the pipelined path the dispatch is split — enqueue the
+        device work (``dispatch_padded``), host-pack the NEXT batch
+        while it runs, then fetch — with the overlapped pack seconds
+        excluded from the ledger's device window. Transient failures
+        retry with backoff (``dispatch_retry``); anything else fans out
+        to THIS batch's futures and feeds the circuit breaker's
+        consecutive-failure count."""
         from photon_tpu import obs
 
         t0 = time.perf_counter()
@@ -1013,9 +1239,29 @@ class MicroBatchQueue:
 
         def attempt():
             nonlocal dispatch_ts, scatter_ts
-            feats, codes, _rung = self.programs.pack_requests(
-                [(r.features, r.entity_ids) for r in batch]
-            )
+            if (
+                staged is not None
+                and staged.packed is not None
+                and staged.programs is self.programs
+            ):
+                # Packed while the previous batch was in flight — the
+                # whole point of the staging pipeline. Valid because a
+                # values-only reload keeps the programs object and a
+                # structure reload fails the identity check above.
+                feats, codes, _rung = staged.packed
+            else:
+                pack_t0 = time.perf_counter()
+                feats, codes, _rung = self.programs.pack_requests(
+                    [(r.features, r.entity_ids) for r in batch]
+                )
+                # Un-overlapped pack time (first batch of a burst, a
+                # re-pack after a structure reload, or the serial
+                # worker): counted in staging_seconds so the overlap
+                # fraction's denominator is ALL pack work, not just
+                # the part the pipeline managed to hide.
+                pack_dt = time.perf_counter() - pack_t0
+                with self._cond:
+                    self._stats["staging_seconds"] += pack_dt
             # Cold lookups PER COORDINATE (codes are keyed by
             # coordinate, each resolved against its own vocabulary):
             # the aggregate hides a cold coordinate when two
@@ -1025,10 +1271,24 @@ class MicroBatchQueue:
                 for nm, vec in codes.items()
             }
             dispatch_ts = time.perf_counter()
+            dp = getattr(self.programs, "dispatch_padded", None)
             with obs.span("serve/batch"):
-                scores = self.programs.score_padded(
-                    feats, codes, len(batch)
-                )
+                if self.pipeline_staging and dp is not None:
+                    handle = dp(feats, codes, len(batch))
+                    # Device is busy: pop + pack batch k+1 NOW. The
+                    # returned pack seconds are excluded from the
+                    # fetch's ledger window (satellite: overlap must
+                    # not inflate vs_roofline on serve rows).
+                    overlap = self._stage_next()
+                    scores = self.programs.fetch_padded(
+                        handle, exclude_seconds=overlap
+                    )
+                else:
+                    # Serial fallback: pipelining off, or a programs
+                    # object without the split dispatch/fetch API.
+                    scores = self.programs.score_padded(
+                        feats, codes, len(batch)
+                    )
             scatter_ts = time.perf_counter()
             return cold_by_coord, len(codes) * len(batch), scores
 
@@ -1067,6 +1327,12 @@ class MicroBatchQueue:
                     self._stats["breaker_trips"] += 1
                     drained = list(self._pending)
                     self._pending.clear()
+                    # The staged batch is popped off the deque but not
+                    # yet dispatched — its futures would strand if only
+                    # the deque drained.
+                    if self._staged is not None:
+                        drained.extend(self._staged.requests)
+                        self._staged = None
                     self._cond.notify_all()
             for r in batch:
                 r.future.set_exception(exc)

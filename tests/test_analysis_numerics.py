@@ -391,6 +391,7 @@ def test_repo_audit_reports_flow_facts():
         "precision-policy-numerics",
         "fused-fit-numerics",
         "segment-reduce-numerics",
+        "serve-kernel-numerics",
         "serving-numerics",
     }
     fused = contracts["fused-fit-numerics"]["programs"]

@@ -620,3 +620,105 @@ class TestBucketScoring:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-9
         )
+
+
+class TestGramRoute:
+    """The Hessian segment-reduce route: direct squared-loss solves on
+    wide-subspace ELL buckets build per-entity X'WX / X'Wy through the
+    windowed-one-hot kernel instead of densifying [B, R, S] one-hots.
+    The route must be value-identical to the scatter path (it feeds the
+    same batched SPD solve)."""
+
+    def _wide_game(self, seed=7, n=900, d=400, k=5, num_entities=6):
+        """Entities draw from per-entity 160-feature pools so the union
+        subspace exceeds DENSE_SUB_DIM_MAX=128 — the shape the gram
+        route exists for (narrower buckets densify instead)."""
+        from photon_tpu.data.dataset import SparseFeatures
+
+        rng = np.random.default_rng(seed)
+        pools = [
+            rng.choice(d, size=160, replace=False)
+            for _ in range(num_entities)
+        ]
+        entities = rng.integers(0, num_entities, size=n)
+        idx = np.stack([
+            rng.choice(pools[e], size=k, replace=False) for e in entities
+        ]).astype(np.int32)
+        val = rng.integers(-2, 3, size=(n, k)).astype(np.float64)
+        y = rng.normal(size=n)
+        return make_game_dataset(
+            y,
+            {"shard": SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)},
+            id_tags={"userId": np.asarray([f"u{e}" for e in entities])},
+            dtype=jnp.float32,
+        )
+
+    def _train(self, game, mode, monkeypatch):
+        import photon_tpu.algorithm.random_effect as rem
+        from photon_tpu.ops import segment_reduce as sr
+
+        monkeypatch.setenv("PHOTON_SEGMENT_KERNEL", mode)
+        # the engagement gate reads the env flag at trace time: never
+        # let one mode's cached trace serve the other's avals
+        rem._solve_block.clear_cache()
+        ds = build_random_effect_dataset(
+            game, RandomEffectDataConfiguration("userId", "shard"),
+            lazy=False,
+        )
+        assert ds.max_sub_dim > 128  # wide: the densify path is closed
+        conf = GLMOptimizationConfiguration(
+            regularization=optim.RegularizationContext(
+                optim.RegularizationType.L2),
+            regularization_weight=0.5,
+        )
+        coord = RandomEffectCoordinate(
+            ds, TaskType.LINEAR_REGRESSION, conf
+        )
+        before = sr.traced_sites().get(
+            "segment_reduce/gram", {}
+        ).get("instances", 0)
+        model, stats = coord.train()
+        after = sr.traced_sites().get(
+            "segment_reduce/gram", {}
+        ).get("instances", 0)
+        rem._solve_block.clear_cache()
+        return ds, np.asarray(model.coefficients), stats, after - before
+
+    def test_force_matches_scatter_path(self, monkeypatch):
+        game = self._wide_game()
+        ds, w_off, stats_off, traced_off = self._train(
+            game, "off", monkeypatch
+        )
+        _, w_force, stats_force, traced_force = self._train(
+            game, "force", monkeypatch
+        )
+        # plan-time window bounds were computed for the wide bucket
+        assert any(m is not None for m in ds.block_gram_mults)
+        # the route actually engaged under force (grad + hess reduces)
+        assert traced_force >= 2
+        assert traced_off == 0
+        np.testing.assert_allclose(w_force, w_off, rtol=1e-4, atol=1e-5)
+        # both paths report the direct solve's one-step convergence
+        assert stats_force.iterations_max == 1
+        assert set(stats_force.convergence_reason_counts) == {
+            "GRADIENT_CONVERGED"
+        }
+
+    def test_narrow_buckets_carry_no_bounds(self, rng):
+        # sub_dim <= DENSE_SUB_DIM_MAX densifies: no bounds computed
+        game, _ = _toy_game_dataset(rng, n=160, d=6, num_entities=5)
+        ds = build_random_effect_dataset(
+            game, RandomEffectDataConfiguration("userId", "shard"),
+            lazy=False,
+        )
+        assert all(m is None for m in ds.block_gram_mults)
+
+    def test_lazy_datasets_skip_bounds(self, rng):
+        # lazy buckets have no host slab to count over; the gram route
+        # stays off by construction
+        game, _ = _toy_game_dataset(rng, n=160, d=6, num_entities=5)
+        ds = build_random_effect_dataset(
+            game, RandomEffectDataConfiguration("userId", "shard"),
+            lazy=True,
+        )
+        assert ds.block_gram_mults == ()

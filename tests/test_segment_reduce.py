@@ -256,3 +256,138 @@ def test_contract_gate():
         if not f.suppressed
     ]
     assert findings == [], [f.format() for f in findings]
+
+
+class TestEllGram:
+    """The Hessian segment-reduce route: per-entity gram blocks
+    (X'WX) and moment slots (X'Wy) built straight from the ELL layout
+    through ONE sorted_segment_sum each. Small-integer fixtures make
+    f32 accumulation EXACT, so the scatter reference must match
+    bit-for-bit — any dropped or double-counted pair product fails."""
+
+    def _fixture(self, seed=0, B=3, R=17, K=4, S=140):
+        rng = np.random.default_rng(seed)
+        bi = rng.integers(0, S, size=(B, R, K)).astype(np.int32)
+        bv = rng.integers(-3, 4, size=(B, R, K)).astype(np.float32)
+        bv[:, -3:] = 0.0  # padding rows (capacity > rows)
+        w = rng.integers(0, 3, size=(B, R)).astype(np.float32)
+        return bi, bv, w
+
+    def _bounds(self, bi, bv, S):
+        B = bi.shape[0]
+        ent = np.arange(B, dtype=np.int64)[:, None, None]
+        nz = bv != 0
+        gids = (ent * S + bi)[nz]
+        grad = sr.window_bound_from_counts(
+            sr.window_counts_np(gids, B * S).max()
+        )
+        pair_nz = nz[:, :, :, None] & nz[:, :, None, :]
+        pids = (
+            ent[..., None] * (S * S)
+            + bi[:, :, :, None].astype(np.int64) * S
+            + bi[:, :, None, :]
+        )[pair_nz]
+        hess = sr.window_bound_from_counts(
+            sr.window_counts_np(pids, B * S * S).max()
+        )
+        return grad, hess
+
+    def _reference(self, bi, bv, w, S):
+        B, R, _ = bi.shape
+        x = np.zeros((B, R, S), np.float64)
+        for b in range(B):
+            for r in range(R):
+                for j in range(bi.shape[2]):
+                    x[b, r, bi[b, r, j]] += bv[b, r, j]
+        gram = np.einsum("br,brs,brt->bst", w, x, x)
+        slots = np.einsum("br,brs->bs", w, x)
+        return gram.astype(np.float32), slots.astype(np.float32)
+
+    def test_gram_and_slots_exact(self, force_kernel):
+        bi, bv, w = self._fixture()
+        S = 140
+        grad_mult, hess_mult = self._bounds(bi, bv, S)
+        assert sr.ell_gram_supported(
+            *bi.shape, S, grad_mult=grad_mult, hess_mult=hess_mult
+        )
+        gram = sr.ell_gram_blocks(
+            jnp.asarray(bi), jnp.asarray(bv), jnp.asarray(w), S,
+            multiplicity=hess_mult,
+        )
+        slots = sr.ell_segment_slots(
+            jnp.asarray(bi), jnp.asarray(bv), jnp.asarray(w), S,
+            multiplicity=grad_mult,
+        )
+        assert gram is not None and slots is not None
+        ref_gram, ref_slots = self._reference(bi, bv, w, S)
+        np.testing.assert_array_equal(np.asarray(gram), ref_gram)
+        np.testing.assert_array_equal(np.asarray(slots), ref_slots)
+
+    def test_duplicate_slots_within_row(self, force_kernel):
+        # ELL rows may repeat a slot (photon-ml's raw layout before
+        # coalescing): the pair products must sum, not overwrite.
+        S = 133
+        bi = np.asarray([[[5, 5, 60], [7, 5, 5]]], np.int32)
+        bv = np.asarray([[[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]], np.float32)
+        w = np.asarray([[2.0, 1.0]], np.float32)
+        grad_mult, hess_mult = self._bounds(bi, bv, S)
+        gram = sr.ell_gram_blocks(
+            jnp.asarray(bi), jnp.asarray(bv), jnp.asarray(w), S,
+            multiplicity=hess_mult,
+        )
+        slots = sr.ell_segment_slots(
+            jnp.asarray(bi), jnp.asarray(bv), jnp.asarray(w), S,
+            multiplicity=grad_mult,
+        )
+        ref_gram, ref_slots = self._reference(bi, bv, w, S)
+        np.testing.assert_array_equal(np.asarray(gram), ref_gram)
+        np.testing.assert_array_equal(np.asarray(slots), ref_slots)
+
+    def test_bf16_values_accumulate_f32(self, force_kernel):
+        # bf16 slab in, f32 gram out: products are formed in f32.
+        bi, bv, w = self._fixture(seed=4, S=130)
+        bvh = jnp.asarray(bv).astype(jnp.bfloat16)
+        S = 130
+        bi = np.minimum(bi, S - 1)
+        grad_mult, hess_mult = self._bounds(
+            bi, np.asarray(bvh, np.float32), S
+        )
+        gram = sr.ell_gram_blocks(
+            jnp.asarray(bi), bvh, jnp.asarray(w), S,
+            multiplicity=hess_mult,
+        )
+        assert gram is not None and gram.dtype == jnp.float32
+        ref_gram, _ = self._reference(
+            bi, np.asarray(bvh, np.float32), w, S
+        )
+        np.testing.assert_allclose(
+            np.asarray(gram), ref_gram, rtol=1e-6, atol=1e-5
+        )
+
+    def test_window_bound_helpers(self):
+        # counts are per _OUT_TILE window of the flat segment space
+        ids = np.asarray([0, 1, 1023, 1024, 5000], np.int64)
+        counts = sr.window_counts_np(ids, 8192)
+        assert counts.shape == (8,)
+        assert counts[0] == 3 and counts[1] == 1 and counts[4] == 1
+        assert sr.window_bound_from_counts(0) == 1
+        assert sr.window_bound_from_counts(1024) == 1
+        assert sr.window_bound_from_counts(1025) == 2
+
+    def test_unsupported_shapes_return_none(self, force_kernel):
+        bi, bv, w = self._fixture()
+        # a multiplicity bound past _MAX_K_TILES refuses the route
+        assert not sr.ell_gram_supported(
+            *bi.shape, 140, grad_mult=1, hess_mult=10_000
+        )
+        assert sr.ell_gram_blocks(
+            jnp.asarray(bi), jnp.asarray(bv), jnp.asarray(w), 140,
+            multiplicity=10_000,
+        ) is None
+
+    def test_off_flag_refuses_route(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_SEGMENT_KERNEL", "off")
+        bi, bv, w = self._fixture()
+        assert not sr.ell_gram_supported(
+            *bi.shape, 140, grad_mult=1, hess_mult=1
+        )

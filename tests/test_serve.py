@@ -588,6 +588,137 @@ class TestQueue:
         assert len(fired) == 50
 
 
+class TestPipelinedStaging:
+    """The double-buffered staging pipeline: batch k+1's host pack
+    overlaps batch k's device dispatch. Scores, drain guarantees, and
+    the hot-reload contract must be indistinguishable from the serial
+    queue — only the stats may differ."""
+
+    def _programs(self, rng, rungs=(1, 4, 16)):
+        tables = CoefficientTables.from_game_model(_glmix_model(rng))
+        return tables, ScorePrograms(tables, ladder=ShapeLadder(rungs))
+
+    def _requests(self, seed, n):
+        prng = np.random.default_rng(seed)
+        return [
+            (
+                {
+                    "features": prng.normal(size=D).astype(np.float32),
+                    "userShard": prng.normal(size=DU).astype(np.float32),
+                },
+                {"userId": str(i % (E + 2))},  # some cold
+            )
+            for i in range(n)
+        ]
+
+    def test_pipelined_matches_serial_byte_identical(self, rng):
+        model = _glmix_model(rng)
+        reqs = self._requests(7, 60)
+        outs = {}
+        for pipelined in (False, True):
+            tables = CoefficientTables.from_game_model(model)
+            programs = ScorePrograms(tables, ladder=ShapeLadder((1, 4)))
+            with MicroBatchQueue(
+                programs, max_linger_s=0.001,
+                pipeline_staging=pipelined,
+            ) as q:
+                futs = [q.submit(*r) for r in reqs]
+                outs[pipelined] = np.asarray(
+                    [f.result(timeout=30) for f in futs]
+                )
+            if pipelined:
+                assert q.stats()["staged_batches"] >= 1
+        assert np.array_equal(outs[False], outs[True])
+
+    def test_staging_stats_surfaced(self, rng):
+        _, programs = self._programs(rng)
+        with MicroBatchQueue(programs, max_linger_s=0.001) as q:
+            futs = [
+                q.submit(*r) for r in self._requests(9, 30)
+            ]
+            for f in futs:
+                f.result(timeout=30)
+        stats = q.stats()
+        assert stats["staged_batches"] >= 1
+        assert 0.0 <= stats["staging_overlap_fraction"] <= 1.0
+        assert stats["staging_seconds"] >= 0.0
+        health = q.health()
+        assert health["pipeline_staging"] is True
+        fams = {f["name"] for f in q.metrics_families()}
+        assert "serve_staging_overlap_fraction" in fams
+
+    def test_hammer_quiesce_and_reload_mid_stream(self, rng):
+        """Concurrent producers + a quiesce window + two values-only
+        reloads against the LIVE pipelined queue: every future must
+        resolve (no stranded staged batch), counters must balance."""
+        tables, programs = self._programs(rng)
+        futures: list = []
+        lock = threading.Lock()
+
+        with MicroBatchQueue(
+            programs, max_linger_s=0.001, max_queue=64,
+        ) as q:
+
+            def producer(seed):
+                prng = np.random.default_rng(seed)
+                for _ in range(40):
+                    fut = q.submit(
+                        {
+                            "features": prng.normal(size=D)
+                            .astype(np.float32),
+                            "userShard": prng.normal(size=DU)
+                            .astype(np.float32),
+                        },
+                        {"userId": str(seed % E)},
+                    )
+                    with lock:
+                        futures.append(fut)
+
+            threads = [
+                threading.Thread(target=producer, args=(i,))
+                for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            # reload mid-stream: same structure (fixed projector
+            # seed), fresh values -> the zero-recompile swap, while a
+            # staged batch may be in the hand-off slot
+            for attempt in range(2):
+                out = q.reload_model(
+                    _glmix_model(np.random.default_rng(100 + attempt))
+                )
+                assert out["values_only"] is True
+                assert out["programs_compiled"] == 0
+            # a quiesce window mid-stream must park the worker without
+            # dropping anything queued OR staged
+            with q.quiesce():
+                time.sleep(0.01)
+            for t in threads:
+                t.join()
+        # close() drained: zero stranded futures
+        assert len(futures) == 160
+        assert all(f.done() for f in futures)
+        vals = [f.result(timeout=1) for f in futures]
+        assert np.isfinite(vals).all()
+        stats = q.stats()
+        assert stats["requests"] == 160
+        assert stats["batched_requests"] == 160
+        assert stats["dispatch_errors"] == 0
+
+    def test_serial_flag_disables_staging(self, rng):
+        _, programs = self._programs(rng)
+        with MicroBatchQueue(
+            programs, max_linger_s=0.001, pipeline_staging=False,
+        ) as q:
+            futs = [q.submit(*r) for r in self._requests(5, 12)]
+            for f in futs:
+                assert np.isfinite(f.result(timeout=30))
+        stats = q.stats()
+        assert stats["staged_batches"] == 0
+        assert stats["staging_overlapped_seconds"] == 0.0
+        assert q.health()["pipeline_staging"] is False
+
+
 class TestDriver:
     def test_drive_reports_tail_and_fill(self, rng):
         tables = CoefficientTables.from_game_model(_glmix_model(rng))

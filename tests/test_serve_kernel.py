@@ -1,0 +1,267 @@
+"""Parity matrix for the fused serve kernel (ops/serve_kernel.py).
+
+The kernel runs FORCED through the Pallas interpreter on CPU
+(``PHOTON_SERVE_KERNEL=force`` + ``interpret_required()``); the jitted
+per-coordinate score chain — the path every prior release served with —
+is the oracle. The matrix walks the serving acceptance surface: dense
+and sparse-ELL request specs, cold rows (code −1), the empty
+random-effect coordinate, bf16 vs f32 tables, and every ladder rung
+including the latency rung 1.
+
+Per-entity projector ids are DISTINCT within a row (the trained-model
+invariant ``proj_all`` carries): duplicate ids are out-of-contract for
+both paths (``.at[].set`` overwrite vs one-hot sum diverge).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.ops import serve_kernel
+from photon_tpu.serve.programs import (
+    FeatureSpec,
+    ScorePrograms,
+    ShapeLadder,
+)
+from photon_tpu.serve.tables import CoefficientTables
+from photon_tpu.types import TaskType
+
+D, DU, E, S = 7, 8, 9, 4
+
+
+def _model(entities=E, seed=3, task=TaskType.LOGISTIC_REGRESSION):
+    rng = np.random.default_rng(seed)
+    if entities:
+        proj = np.stack([
+            np.sort(rng.choice(DU, size=S, replace=False))
+            for _ in range(entities)
+        ]).astype(np.int64)
+        # A short row: the trailing slot is padding (-1), the serving
+        # tables' layout for entities whose subspace is narrower.
+        proj[0, -1] = -1
+        coeffs = rng.normal(size=(entities, S)).astype(np.float32)
+    else:
+        proj = np.zeros((0, 1), np.int64)
+        coeffs = np.zeros((0, 1), np.float32)
+    return GameModel({
+        "global": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(means=jnp.asarray(
+                    rng.normal(size=D).astype(np.float32))),
+                task,
+            ),
+            "features",
+        ),
+        "per-user": RandomEffectModel(
+            coefficients=jnp.asarray(coeffs),
+            random_effect_type="userId",
+            feature_shard_id="userShard",
+            task=task,
+            proj_all=proj,
+            entity_keys=tuple(str(i) for i in range(entities)),
+        ),
+    })
+
+
+def _dense_requests(rng, n, entities=E):
+    reqs = []
+    for i in range(n):
+        feats = {
+            "features": rng.normal(size=D).astype(np.float32),
+            "userShard": rng.normal(size=DU).astype(np.float32),
+        }
+        # every 4th request is cold (no entity id -> code -1)
+        ids = {} if i % 4 == 3 else {"userId": str(i % max(entities, 1))}
+        reqs.append((feats, ids))
+    return reqs
+
+
+def _sparse_requests(rng, n, k=3, entities=E):
+    reqs = []
+    for i in range(n):
+        feats = {
+            "features": (
+                rng.choice(D, size=k, replace=False).astype(np.int32),
+                rng.normal(size=k).astype(np.float32),
+            ),
+            "userShard": (
+                rng.choice(DU, size=k, replace=False).astype(np.int32),
+                rng.normal(size=k).astype(np.float32),
+            ),
+        }
+        ids = {} if i % 4 == 3 else {"userId": str(i % max(entities, 1))}
+        reqs.append((feats, ids))
+    return reqs
+
+
+def _score_both(model, reqs, precision, *, specs=None, rungs=(1, 8),
+                monkeypatch=None):
+    """Score the same packed batch with the kernel off and forced;
+    returns (off, force) numpy score vectors."""
+    outs = {}
+    for mode in ("off", "force"):
+        monkeypatch.setenv("PHOTON_SERVE_KERNEL", mode)
+        tables = CoefficientTables.from_game_model(model, precision)
+        progs = ScorePrograms(
+            tables, ladder=ShapeLadder(rungs), specs=specs,
+            compile_now=False,
+        )
+        assert progs.use_kernel == (mode == "force")
+        rung = progs.ladder.rung_for(len(reqs))
+        progs.compile_rung(rung)
+        feats, codes, _ = progs.pack_requests(reqs)
+        outs[mode] = progs.score_padded(feats, codes, len(reqs))
+    return outs["off"], outs["force"]
+
+
+class TestParityMatrix:
+    @pytest.mark.parametrize("precision,tol", [
+        ("float32", 1e-5),
+        ("bfloat16", 5e-2),
+    ])
+    @pytest.mark.parametrize("n", [1, 8])
+    def test_dense_specs(self, monkeypatch, precision, tol, n):
+        rng = np.random.default_rng(11)
+        off, force = _score_both(
+            _model(), _dense_requests(rng, n), precision,
+            monkeypatch=monkeypatch,
+        )
+        assert off.shape == force.shape == (n,)
+        np.testing.assert_allclose(force, off, atol=tol, rtol=0)
+
+    @pytest.mark.parametrize("precision,tol", [
+        ("float32", 1e-5),
+        ("bfloat16", 5e-2),
+    ])
+    @pytest.mark.parametrize("n", [1, 8])
+    def test_sparse_ell_specs(self, monkeypatch, precision, tol, n):
+        rng = np.random.default_rng(13)
+        specs = {
+            "features": FeatureSpec("sparse", D, k=3),
+            "userShard": FeatureSpec("sparse", DU, k=3),
+        }
+        off, force = _score_both(
+            _model(), _sparse_requests(rng, n), precision, specs=specs,
+            monkeypatch=monkeypatch,
+        )
+        np.testing.assert_allclose(force, off, atol=tol, rtol=0)
+
+    def test_mixed_dense_fe_sparse_re(self, monkeypatch):
+        # Dense fixed-effect shard + sparse random-effect shard in ONE
+        # program: exercises both gather branches in a single kernel.
+        rng = np.random.default_rng(17)
+        specs = {
+            "features": FeatureSpec("dense", D),
+            "userShard": FeatureSpec("sparse", DU, k=3),
+        }
+        reqs = []
+        for i in range(5):
+            feats = {
+                "features": rng.normal(size=D).astype(np.float32),
+                "userShard": (
+                    rng.choice(DU, size=3, replace=False).astype(np.int32),
+                    rng.normal(size=3).astype(np.float32),
+                ),
+            }
+            ids = {} if i == 2 else {"userId": str(i)}
+            reqs.append((feats, ids))
+        off, force = _score_both(
+            _model(), reqs, "float32", specs=specs,
+            monkeypatch=monkeypatch,
+        )
+        np.testing.assert_allclose(force, off, atol=1e-5, rtol=0)
+
+    def test_all_cold_rows(self, monkeypatch):
+        # Every request cold: the kernel's mask must zero the whole
+        # random-effect contribution, leaving the fixed effect.
+        rng = np.random.default_rng(19)
+        reqs = [(f, {}) for f, _ in _dense_requests(rng, 8)]
+        off, force = _score_both(
+            _model(), reqs, "float32", monkeypatch=monkeypatch,
+        )
+        np.testing.assert_allclose(force, off, atol=1e-5, rtol=0)
+
+    def test_empty_random_effect_coordinate(self, monkeypatch):
+        # A model saved before any entity trained: the RE table has 0
+        # entities and is dropped statically — the kernel serves a
+        # fixed-effect-only program.
+        rng = np.random.default_rng(23)
+        reqs = [
+            ({"features": rng.normal(size=D).astype(np.float32)}, {})
+            for _ in range(3)
+        ]
+        off, force = _score_both(
+            _model(entities=0), reqs, "float32", monkeypatch=monkeypatch,
+        )
+        np.testing.assert_allclose(force, off, atol=1e-5, rtol=0)
+
+
+class TestKernelDirect:
+    def test_interpret_flag_explicit(self, monkeypatch):
+        # fused_score(interpret=True) must match the off path even when
+        # the env flag would not force interpretation itself.
+        monkeypatch.setenv("PHOTON_SERVE_KERNEL", "off")
+        rng = np.random.default_rng(29)
+        tables = CoefficientTables.from_game_model(_model(), "float32")
+        progs = ScorePrograms(
+            tables, ladder=ShapeLadder((8,)), compile_now=False,
+        )
+        progs.compile_rung(8)
+        reqs = _dense_requests(rng, 8)
+        feats, codes, rung = progs.pack_requests(reqs)
+        ref = progs.score_padded(feats, codes, len(reqs))
+        fe_ws, re_ws, re_projs = progs._table_args()
+        f = tuple(feats[s] for s in progs.shard_order)
+        c = tuple(
+            jnp.asarray(codes[nm], dtype=jnp.int32)
+            for nm in progs._re_names
+        )
+        shard_idx = {s: i for i, s in enumerate(progs.shard_order)}
+        out = serve_kernel.fused_score(
+            fe_ws, re_ws, re_projs, f, c,
+            spec_kinds=tuple(
+                progs.specs[s].kind for s in progs.shard_order
+            ),
+            fe_feat=tuple(
+                shard_idx[tables.fixed[n].feature_shard_id]
+                for n in progs._fe_names
+            ),
+            re_feat=tuple(
+                shard_idx[tables.random[n].feature_shard_id]
+                for n in progs._re_names
+            ),
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out)[: len(reqs)], ref, atol=1e-5, rtol=0
+        )
+
+    def test_flag_gate(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_SERVE_KERNEL", "off")
+        assert not serve_kernel.kernel_supported("float32")
+        monkeypatch.setenv("PHOTON_SERVE_KERNEL", "force")
+        assert serve_kernel.kernel_supported("float32")
+        assert serve_kernel.kernel_supported("bfloat16")
+        # non-float table dtypes never engage the kernel
+        assert not serve_kernel.kernel_supported("int32")
+
+    def test_trace_census_records_site(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_SERVE_KERNEL", "force")
+        rng = np.random.default_rng(31)
+        tables = CoefficientTables.from_game_model(_model(), "float32")
+        progs = ScorePrograms(
+            tables, ladder=ShapeLadder((8,)), compile_now=False,
+        )
+        progs.trace(8)
+        sites = serve_kernel.traced_sites()
+        assert "serve_kernel/score" in sites
+        assert sites["serve_kernel/score"]["instances"] >= 1
