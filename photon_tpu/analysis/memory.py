@@ -137,6 +137,11 @@ TIER2_WAIVERS: dict[str, str] = {
         "host-side retry/fault machinery; zero device programs is "
         "already its tier-2 contract"
     ),
+    "fleet-obs": (
+        "host-side bundle shipping and trace merge; its tier-2 "
+        "contract proves byte-identical device programs with the "
+        "fleet armed, and the bundles live on disk, not HBM"
+    ),
     "evaluation-scoring": (
         "one [n] score vector per evaluator invocation, freed on "
         "return; dominated by the fit/serve budgets that feed it"

@@ -190,6 +190,11 @@ TIER2_WAIVERS: dict[str, str] = {
         "host-side retry machinery; zero device programs is already "
         "its tier-2 contract"
     ),
+    "fleet-obs": (
+        "host-side bundle shipping and trace merge in f64 host "
+        "floats; its tier-2 contract proves byte-identical device "
+        "programs with the fleet armed — it traces no reductions"
+    ),
     "evaluation-scoring": (
         "evaluators reduce f32 scores at f64 numpy precision on host; "
         "no bf16 operand can reach them (scores are upcast at the "

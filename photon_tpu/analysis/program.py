@@ -1291,6 +1291,115 @@ def build_trace() -> ContractTrace:
     )
 
 
+def build_fleet() -> ContractTrace:
+    """The distributed-observability layer's audited zero-overhead
+    guarantee (``obs/fleet.py``).
+
+    The fused materialize + whole-fit programs are traced with fleet
+    shipping fully ARMED — telemetry enabled, the host-identity block
+    stamped, the clock-alignment handshake marked (``mark_init``), and
+    a whole bundle COMMITTED to disk (spans JSONL + metrics + ledger
+    rows through ``ship_bundle``) between the two traces. The
+    ``fleet_toggle`` variant must be byte-identical to the all-off
+    base with ZERO added programs: identity is a cached host dict,
+    clock samples are paired ``time()`` reads, and a bundle ship is
+    ring snapshots + atomic file writes — never a traced operand, a
+    host callback in the hot loop, or a cross-host exchange inside a
+    program. Zero added collectives is checked explicitly: the armed
+    lowered HLO must carry exactly the collective census of the base
+    (both empty on the single-device fixture).
+    """
+    import shutil
+    import tempfile
+
+    from photon_tpu import obs
+    from photon_tpu.obs import fleet
+    from photon_tpu.obs import trace as obs_trace
+
+    def _collective_census(lowered) -> list[str]:
+        if lowered is None:
+            return []
+        try:
+            text = lowered.as_text()
+        except Exception:  # noqa: BLE001 — backend without HLO text
+            return []
+        return [op for op in _COLLECTIVE_OPS if op in text]
+
+    with _serial_ingest_env():
+        est, data = _tiny_glmix()
+        datasets, _ = est.prepare(data)
+        coords = est._build_coordinates(
+            datasets, {}, {}, data.num_samples
+        )
+        fused = est._fused_for(coords, datasets)
+        was_enabled = obs.enabled()
+        obs.disable()
+        try:
+            mat_off = trace_program(
+                "materialize", fused._mat_jit, fused._mat_operands(coords)
+            )
+            traced_off = fused.trace(coords)
+            fit_off = TracedProgram(
+                name="fit",
+                text=str(traced_off.jaxpr),
+                jaxpr=traced_off.jaxpr,
+                lowered=traced_off.lower(),
+            )
+            base_census = _collective_census(fit_off.lowered)
+            # Arm the whole fleet layer and COMMIT a real bundle while
+            # the armed trace is taken.
+            obs.enable()
+            tmpdir = tempfile.mkdtemp(prefix="photon-fleet-audit-")
+            try:
+                fleet.set_run_id("fleet-audit")
+                fleet.mark_init()
+                with obs.span("fleet_audit_span"):
+                    pass
+                obs_trace.instant("fleet.audit", cat="audit")
+                fleet.ship_bundle(tmpdir)
+                mat_on = trace_program(
+                    "materialize", fused._mat_jit,
+                    fused._mat_operands(coords),
+                )
+                traced_on = fused.trace(coords)
+                fit_on = TracedProgram(
+                    name="fit",
+                    text=str(traced_on.jaxpr),
+                    lowered=traced_on.lower(),
+                )
+                armed_census = _collective_census(fit_on.lowered)
+            finally:
+                fleet.reset()
+                obs_trace.reset()
+                obs.TRACER.reset()
+                shutil.rmtree(tmpdir, ignore_errors=True)
+        finally:
+            obs.TRACER.enabled = was_enabled
+    if armed_census != base_census:
+        raise RuntimeError(
+            "fleet-armed fit program changed its collective census: "
+            f"base {base_census} vs armed {armed_census}"
+        )
+    return ContractTrace(
+        programs={"materialize": mat_off, "fit": fit_off},
+        variants={
+            "fleet_toggle": [
+                {
+                    "materialize": mat_on.signature,
+                    "fit": fit_on.signature,
+                }
+            ]
+        },
+        collectives=base_census,
+        notes=[
+            "fleet armed (identity stamped, clock handshake marked, "
+            "bundle committed to disk) traced the same materialize/fit "
+            "jaxprs as the all-off base; collective census identical "
+            f"armed vs off ({len(base_census)} ops)",
+        ],
+    )
+
+
 def build_ledger() -> ContractTrace:
     """The cost ledger's audited zero-overhead guarantee.
 
@@ -2158,6 +2267,7 @@ _BUILDERS: dict[str, Callable[[], ContractTrace]] = {
     "build_ingest_pipeline": build_ingest_pipeline,
     "build_telemetry": build_telemetry,
     "build_trace": build_trace,
+    "build_fleet": build_fleet,
     "build_health": build_health,
     "build_ledger": build_ledger,
     "build_monitor": build_monitor,

@@ -37,6 +37,12 @@ Usage:
 This module is the ONE implementation (the old ``tools/bench_trend.py``
 script shim was deleted): every tracked metric — including the cost
 ledger's ``*_attributed_fraction`` — gates in exactly one place.
+
+The ``MULTICHIP_r*.json`` history (the multiprocess dryrun's fleet
+straggler rows, round 19+) gates here too, as a second trend table over
+``MULTICHIP_TRACKED`` — rounds r01-r05 carry only the old rc/tail
+capture schema and contribute nothing to the series, which is exactly
+what the absent-metric rules already tolerate.
 """
 
 from __future__ import annotations
@@ -128,6 +134,26 @@ TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
     "parity_gap_smoothed_hinge": ("lower", 1.5, ()),
 }
 
+# The MULTICHIP_r*.json series (round 19+, photon_tpu.obs.fleet): the
+# multiprocess dryrun's straggler report, gated as its own trend table.
+# Rounds r01-r05 predate the fleet layer and carry only rc/tail capture
+# blobs — no tracked key appears in them, so the series starts the
+# round the gauges first land (the absent-from-all-history skip and the
+# new-metric rule both tolerate the old schema by construction; the
+# dead-gauge rule arms only once a round has reported). Both gauges are
+# bounded small numbers, so the tolerances are absolute-ish bands, not
+# throughput ratios: skew is seconds of max-min attributed dispatch
+# wall across ranks, fraction is the share of the fleet's rank-seconds
+# spent waiting at the barrier.
+MULTICHIP_TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
+    "multichip_straggler_skew_seconds": (
+        "lower", 3.0, ("straggler_skew_seconds",)
+    ),
+    "multichip_collective_fraction": (
+        "lower", 3.0, ("collective_fraction",)
+    ),
+}
+
 # Waivers for BENCH-REPORTED regressions (the `regressions` list a
 # bench run embeds in its own output line). A populated list in the
 # LATEST round fails the trend gate — BENCH_r05 carried
@@ -163,8 +189,31 @@ def load_round(path: str) -> dict | None:
     return doc if isinstance(doc, dict) else None
 
 
-def metric_value(parsed: dict, name: str) -> float | None:
-    _, _, fallbacks = TRACKED[name]
+def load_series(
+    dirpath: str, pattern: str, strip_prefix: str
+) -> tuple[list[tuple[str, dict]], list[str]]:
+    """Ordered (label, parsed) rounds for one history glob, plus the
+    labels of files that would not parse (reported, never fatal)."""
+    rounds: list[tuple[str, dict]] = []
+    skipped: list[str] = []
+    for p in sorted(glob.glob(os.path.join(dirpath, pattern))):
+        parsed = load_round(p)
+        label = os.path.splitext(os.path.basename(p))[0].replace(
+            strip_prefix, ""
+        )
+        if parsed is None:
+            skipped.append(label)
+            continue
+        rounds.append((label, parsed))
+    return rounds, skipped
+
+
+def metric_value(
+    parsed: dict,
+    name: str,
+    tracked: dict[str, tuple[str, float, tuple[str, ...]]] | None = None,
+) -> float | None:
+    _, _, fallbacks = (tracked or TRACKED)[name]
     for key in (name, *fallbacks):
         v = parsed.get(key)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
@@ -175,11 +224,15 @@ def metric_value(parsed: dict, name: str) -> float | None:
 def analyze(
     rounds: list[tuple[str, dict]],
     waivers: dict[str, str] | None = None,
+    tracked: dict[str, tuple[str, float, tuple[str, ...]]] | None = None,
 ) -> dict:
     """Trend rows + regressions for an ordered (label, parsed) series.
 
     ``waivers`` (pattern -> reason) extends ``WAIVED_REGRESSIONS`` for
-    the bench-reported gate below."""
+    the bench-reported gate below. ``tracked`` selects the gauge table
+    (default the bench ``TRACKED`` set; the multichip pass hands in
+    ``MULTICHIP_TRACKED``)."""
+    tracked = TRACKED if tracked is None else tracked
     out: dict = {"rounds": [label for label, _ in rounds], "metrics": {},
                  "regressions": [], "waived": []}
     if not rounds:
@@ -207,8 +260,10 @@ def analyze(
                 out["regressions"].append(
                     f"{latest_label} bench-reported: {entry}"
                 )
-    for name, (direction, tol, _) in TRACKED.items():
-        series = [metric_value(parsed, name) for _, parsed in rounds]
+    for name, (direction, tol, _) in tracked.items():
+        series = [
+            metric_value(parsed, name, tracked) for _, parsed in rounds
+        ]
         if all(v is None for v in series):
             continue
         prior = [v for v in series[:-1] if v is not None]
@@ -285,6 +340,11 @@ def main(argv=None) -> int:
     parser.add_argument("--pattern", default="BENCH_r*.json",
                         help="history glob (lexicographic order = "
                              "round order)")
+    parser.add_argument("--multichip-pattern",
+                        default="MULTICHIP_r*.json",
+                        help="multichip straggler history glob (same "
+                             "--dir; rounds r01-r05 predate the fleet "
+                             "gauges and are tolerated as empty)")
     parser.add_argument("--json", default=None, metavar="PATH",
                         help="also write the machine-readable trend "
                              "report to PATH")
@@ -305,18 +365,7 @@ def main(argv=None) -> int:
                 "required)")
         waivers[pattern] = reason.strip()
 
-    paths = sorted(glob.glob(os.path.join(args.dir, args.pattern)))
-    rounds: list[tuple[str, dict]] = []
-    skipped: list[str] = []
-    for p in paths:
-        parsed = load_round(p)
-        label = os.path.splitext(os.path.basename(p))[0].replace(
-            "BENCH_", ""
-        )
-        if parsed is None:
-            skipped.append(label)
-            continue
-        rounds.append((label, parsed))
+    rounds, skipped = load_series(args.dir, args.pattern, "BENCH_")
 
     report = analyze(rounds, waivers=waivers)
     if skipped:
@@ -324,10 +373,35 @@ def main(argv=None) -> int:
     print(render_table(report))
     for w in report.get("waived", ()):
         print(f"waived: {w['entry']} ({w['reason']})")
+
+    # Second pass: the multichip straggler series. Absent history is
+    # fine (single-host checkouts carry no MULTICHIP_r*.json) — the
+    # gate only arms once the fleet dryrun has committed a row.
+    mc_rounds, mc_skipped = load_series(
+        args.dir, args.multichip_pattern, "MULTICHIP_"
+    )
+    mc_report: dict | None = None
+    if mc_rounds:
+        mc_report = analyze(
+            mc_rounds, waivers=waivers, tracked=MULTICHIP_TRACKED
+        )
+        if mc_skipped:
+            mc_report["skipped_unparseable"] = mc_skipped
+        report["multichip"] = mc_report
+        if mc_report["metrics"]:
+            print("-- multichip (MULTICHIP_r*.json) --")
+            print(render_table(mc_report))
+        report["regressions"].extend(
+            f"multichip: {reg}" for reg in mc_report["regressions"]
+        )
+
     for reg in report["regressions"]:
         print(f"REGRESSION: {reg}")
     if not report["regressions"]:
-        print(f"trend OK across {len(rounds)} round(s)")
+        print(
+            f"trend OK across {len(rounds)} bench + "
+            f"{len(mc_rounds)} multichip round(s)"
+        )
     if args.json:
         with open(args.json, "w") as f:
             json.dump(report, f, indent=2)

@@ -30,7 +30,19 @@ def maybe_init_distributed() -> bool:
             except ImportError:  # pragma: no cover — layout moved again
                 return False
             return getattr(global_state, "client", None) is not None
+    def _mark_fleet_clock() -> None:
+        # The init half of the fleet clock-alignment handshake
+        # (obs/fleet.py): sample the monotonic↔epoch offset and probe
+        # the post-init rank identity, so a later bundle commit can
+        # bound how far this host's clock mapping drifted over the run.
+        # Sampled on EVERY path out of here — single-host runs ship
+        # 1-rank bundles too.
+        from photon_tpu.obs import fleet
+
+        fleet.mark_init()
+
     if initialized():
+        _mark_fleet_clock()
         return False  # idempotent CLI re-entry in one process
     try:
         jax.distributed.initialize()
@@ -41,6 +53,7 @@ def maybe_init_distributed() -> bool:
         # pods silently training independent models would be far worse
         # than failing fast.
         if "coordinator_address" in str(e):
+            _mark_fleet_clock()
             return False
         raise
     except RuntimeError as e:
@@ -52,8 +65,10 @@ def maybe_init_distributed() -> bool:
         # misconfiguration) propagates.
         msg = str(e)
         if ("before any JAX" in msg or "called once" in msg):
+            _mark_fleet_clock()
             return False
         raise
+    _mark_fleet_clock()
     logging.getLogger("photon.cli").info(
         "multi-host runtime up: process %d/%d, %d global device(s)",
         jax.process_index(), jax.process_count(), len(jax.devices()),

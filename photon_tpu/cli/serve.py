@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import os
 import sys
 
@@ -233,9 +234,19 @@ def _run_instrumented(args, obs, compile_event_count) -> int:
 
     mon = None
     if args.monitor_port is not None:
+        from photon_tpu.obs import fleet
+
+        # Rank-offset the bind (base + process_index): several ranks
+        # sharing one host must not collide on one --monitor-port value.
+        port = fleet.resolve_monitor_port(args.monitor_port)
         mon = monitor.MonitorServer(
-            args.monitor_port, readiness=_readiness
+            port, readiness=_readiness
         ).start()
+        logging.getLogger("photon.serve").info(
+            "monitor endpoints on port %d (requested %d, rank %d)",
+            mon.port, args.monitor_port,
+            fleet.host_identity()["process_index"],
+        )
     try:
         return _serve_instrumented(
             args, obs, compile_event_count, mon, ready_state, queue_ref

@@ -109,10 +109,27 @@ def main(argv=None) -> int:
                         help="serve /metrics (Prometheus text "
                              "exposition from the live metrics "
                              "registry), /healthz, and /readyz on this "
-                             "port for the whole run (0 = ephemeral). "
-                             "/readyz flips 200 once the training "
-                             "datasets are prepared "
+                             "port for the whole run (0 = ephemeral; "
+                             "multi-process runs bind port + "
+                             "process_index so ranks sharing a host "
+                             "never collide). /readyz flips 200 once "
+                             "the training datasets are prepared "
                              "(OBSERVABILITY.md §live monitoring)")
+    parser.add_argument("--distributed", action="store_true",
+                        help="arm distributed observability "
+                             "(obs/fleet.py): telemetry + the cost "
+                             "ledger record for the whole run and this "
+                             "rank commits an atomic obs bundle into "
+                             "the shared fleet dir at exit — merge the "
+                             "ranks with python -m "
+                             "photon_tpu.cli.fleetview "
+                             "(OBSERVABILITY.md §distributed "
+                             "observability). Single-process runs ship "
+                             "a 1-rank fleet")
+    parser.add_argument("--fleet-dir", default=None, metavar="DIR",
+                        help="shared run directory for --distributed "
+                             "bundles (default: $PHOTON_FLEET_DIR, "
+                             "else <output_dir>/fleet)")
     args = parser.parse_args(argv)
     if (args.resume and args.checkpoint_dir
             and os.path.abspath(args.resume)
@@ -145,7 +162,16 @@ def main(argv=None) -> int:
         from photon_tpu import obs
 
         was_enabled = obs.enabled()
-        if args.telemetry or args.trace:
+        from photon_tpu.obs import ledger
+
+        ledger_was_enabled = ledger.enabled()
+        if args.distributed:
+            # The fleet bundle wants the full attribution surface:
+            # spans + events (telemetry) AND the PR 12 ledger rows the
+            # straggler report rolls up. Both are audited host-only
+            # layers (the tier-2 telemetry/ledger/fleet-obs contracts).
+            ledger.enable()
+        if args.telemetry or args.trace or args.distributed:
             # DESTRUCTIVE by design: the --telemetry/--trace run owns
             # the process's telemetry stream (a JSONL mixing a prior
             # session's records into this run's artifact would be
@@ -156,6 +182,40 @@ def main(argv=None) -> int:
             # to would be an empty trace.json, silently.
             obs.reset()
             obs.enable()
+        if args.distributed:
+            # obs.reset() above dropped fleet state too — including the
+            # init clock sample maybe_init_distributed() took. Re-arm
+            # the init half of the handshake NOW, or the commit-time
+            # skew bound pairs a sample against itself and degrades to
+            # spread-only. And pin the run id every rank will stamp:
+            # explicit set_run_id / PHOTON_RUN_ID wins; otherwise
+            # derive it from the shared fleet dir path, identical on
+            # every rank by construction.
+            from photon_tpu.obs import fleet
+
+            fleet.mark_init()
+            if fleet.run_id() is None:
+                try:
+                    resolved = (
+                        args.fleet_dir
+                        or os.environ.get("PHOTON_FLEET_DIR")
+                    )
+                    if not resolved:
+                        from photon_tpu.cli.config import TrainingConfig
+
+                        resolved = os.path.join(
+                            TrainingConfig.load(args.config).output_dir,
+                            "fleet",
+                        )
+                    import zlib
+
+                    digest = zlib.crc32(
+                        os.path.abspath(resolved).encode("utf-8"))
+                    fleet.set_run_id(f"train-{digest & 0xffffffff:08x}")
+                except Exception:
+                    # A bad config fails loudly inside _run; bundles
+                    # from the doomed run just ship without a run id.
+                    pass
         from photon_tpu.obs import flight
 
         # Live monitoring (obs/monitor.py): /healthz answers as soon as
@@ -172,12 +232,20 @@ def main(argv=None) -> int:
                 prepared = gauges.get("train_datasets_prepared", 0) >= 1
                 return prepared, {"datasets_prepared": prepared}
 
+            from photon_tpu.obs import fleet
+
+            # Rank-offset the bind (base + process_index): several
+            # ranks sharing one host must not collide on one
+            # --monitor-port value.
             mon = monitor.MonitorServer(
-                args.monitor_port, readiness=_train_ready
+                fleet.resolve_monitor_port(args.monitor_port),
+                readiness=_train_ready,
             ).start()
             logging.getLogger("photon.train").info(
-                "monitor endpoints on port %d "
-                "(/metrics /healthz /readyz)", mon.port)
+                "monitor endpoints on port %d (requested %d, rank %d) "
+                "(/metrics /healthz /readyz)", mon.port,
+                args.monitor_port,
+                fleet.host_identity()["process_index"])
 
         # _run installs the CLI's own recorder (unless --no-flight);
         # dump/uninstall below are gated on that install actually having
@@ -198,6 +266,32 @@ def main(argv=None) -> int:
         finally:
             if mon is not None:
                 mon.stop()
+            if args.distributed:
+                # Ship THIS rank's bundle before the recorder teardown
+                # below (its restore path may reset the rings) — a
+                # failed run still leaves its half of the fleet
+                # post-mortem. The merge side (cli.fleetview) joins the
+                # ranks afterwards.
+                try:
+                    from photon_tpu.obs import fleet
+
+                    fleet_dir = (
+                        args.fleet_dir
+                        or os.environ.get("PHOTON_FLEET_DIR")
+                    )
+                    if not fleet_dir:
+                        from photon_tpu.cli.config import TrainingConfig
+
+                        fleet_dir = os.path.join(
+                            TrainingConfig.load(args.config).output_dir,
+                            "fleet",
+                        )
+                    out_dir = fleet.ship_bundle(fleet_dir)
+                    logging.getLogger("photon.train").info(
+                        "fleet bundle committed to %s", out_dir)
+                except Exception:
+                    logging.getLogger("photon.train").exception(
+                        "failed to ship the fleet bundle")
             # Uninstall FIRST: it restores the telemetry flag to the
             # state it found at install time (inside _run), and the
             # --telemetry/--trace restore below must win over it.
@@ -208,7 +302,8 @@ def main(argv=None) -> int:
                     # caller's ambient recorder: hand it back re-armed,
                     # so the caller's post-mortem coverage survives.
                     flight.reinstall(prior_rec)
-                elif not (args.telemetry or args.trace) and not was_enabled:
+                elif (not (args.telemetry or args.trace
+                           or args.distributed) and not was_enabled):
                     # The flight install was the ONLY thing recording
                     # (caller had telemetry off, asked for no exports):
                     # drop this run's records instead of leaving them
@@ -236,12 +331,14 @@ def main(argv=None) -> int:
                     logging.getLogger("photon.train").exception(
                         "failed to write telemetry to %s", args.telemetry
                     )
-            if args.telemetry or args.trace:
+            if args.telemetry or args.trace or args.distributed:
                 # Restore the caller's prior ENABLED FLAG (the recorded
                 # stream was reset above, by design) so an in-process
                 # caller that keeps telemetry on — the bench's wide-d
                 # block — continues recording after we return.
                 obs.TRACER.enabled = was_enabled
+            if args.distributed and not ledger_was_enabled:
+                ledger.disable()
 
 
 def _run(args) -> int:

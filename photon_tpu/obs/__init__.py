@@ -34,6 +34,7 @@ import logging
 import time
 
 from photon_tpu.obs import convergence
+from photon_tpu.obs import fleet
 from photon_tpu.obs import flight
 from photon_tpu.obs import health
 from photon_tpu.obs import ledger
@@ -159,6 +160,25 @@ PROGRAM_AUDIT = [
         stable_under=("health_toggle",),
         hot_loop=True,
     ),
+    # `fleet-obs`: the distributed-observability layer (obs/fleet.py).
+    # The fused materialize + whole-fit programs are traced with fleet
+    # shipping fully ARMED — identity stamped, the clock handshake
+    # marked, a bundle committed to disk between traces — and must stay
+    # byte-identical to the all-off base with ZERO added programs, zero
+    # added collectives, and zero host callbacks in the hot loop:
+    # identity is a cached host dict, clock samples are two time() reads,
+    # and a bundle ship is ring snapshots + atomic file writes — never a
+    # traced operand, a callback, or a cross-host exchange inside a
+    # program.
+    dict(
+        name="fleet-obs",
+        entry="obs.fleet identity/clock/bundle shipping over "
+        "algorithm.fused_fit (fleet armed + bundle shipped vs off)",
+        builder="build_fleet",
+        max_programs=2,
+        stable_under=("fleet_toggle",),
+        hot_loop=True,
+    ),
 ]
 
 
@@ -205,6 +225,7 @@ def reset() -> None:
     trace.reset()
     ledger.reset()
     health.reset()
+    fleet.reset()
 
 
 def set_span_retention(max_spans: int) -> None:
@@ -226,6 +247,7 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "fleet",
     "flight",
     "health",
     "ledger",

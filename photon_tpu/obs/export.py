@@ -14,7 +14,8 @@ convergence traces + the pipeline/compile-cache reports they absorb):
 JSONL SCHEMA (version 1) — one JSON object per line, discriminated by
 ``type``:
 
-  {"type": "telemetry", "version": 1, "spans_dropped": 0}  # header, first record
+  {"type": "telemetry", "version": 1, "spans_dropped": 0,
+   "host": {...}}  # header, first record; host = fleet identity block
   {"type": "span", "path", "name", "thread", "seconds",
    "device_wait_seconds": float|null, "attrs": {}}
   {"type": "counter", "series", "value"}
@@ -69,8 +70,11 @@ def snapshot() -> dict:
 
     from photon_tpu.obs import TRACER
 
+    from photon_tpu.obs import fleet
+
     out = {
         "enabled": enabled(),
+        "host": fleet.host_identity(),
         "spans": _spans_aggregated(),
         "spans_dropped": TRACER.dropped,
         "metrics": REGISTRY.snapshot(),
@@ -103,12 +107,13 @@ def _spans_aggregated() -> dict:
 
 def write_jsonl(path: str) -> int:
     """Write the full telemetry stream; returns the line count."""
-    from photon_tpu.obs import TRACER, REGISTRY, convergence
+    from photon_tpu.obs import TRACER, REGISTRY, convergence, fleet
 
     lines: list[dict] = [{
         "type": "telemetry",
         "version": 1,
         "spans_dropped": TRACER.dropped,
+        "host": fleet.host_identity(),
     }]
     for sp in TRACER.completed():
         lines.append(sp.to_json())

@@ -1,0 +1,785 @@
+"""Distributed observability: host identity, clock-aligned telemetry
+bundles, and the fleet merge/straggler machinery behind ``cli.fleetview``.
+
+Every obs surface built so far (spans/metrics PR 4, timeline/flight
+PR 8, monitor PR 9, ledger PR 12, health PR 13) is single-process: in a
+``jax.distributed`` run each rank records its own rings on its own
+``time.perf_counter`` clock and nothing joins them. This module is the
+missing fleet layer, in three parts:
+
+**Host identity.** ``host_identity()`` is the provenance block —
+process_index/process_count, hostname, pid, device kind/count, jax
+version, run id — stamped into every obs snapshot (``export.snapshot``),
+JSONL header (``export.write_jsonl``), flight dump (``flight.py``), and
+chrome-trace ``otherData`` (``trace.chrome_trace``), so no artifact from
+a multi-process run is anonymous. Probing is lazy and guarded: jax is
+only consulted when the process already imported it, so stamping never
+initializes a backend as a side effect.
+
+**Clock alignment.** Rings record on ``perf_counter`` (monotonic,
+process-local, epoch-less); cross-host comparison needs the epoch clock.
+The handshake samples the monotonic↔epoch offset twice — at
+``maybe_init_distributed`` time (``mark_init``) and again at bundle
+commit — as back-to-back (epoch, perf) pairs whose spread bounds the
+sampling jitter. ``skew_bound_seconds`` = |offset_commit − offset_init|
++ both spreads: the drift the mapping could have accumulated over the
+run plus the uncertainty of each measurement. The merge shifts each
+host's events onto the shared epoch clock through its own offset, so
+cross-host ordering in the merged timeline is trustworthy to that bound.
+
+**Bundles + merge.** ``ship_bundle(run_dir)`` commits this rank's whole
+obs state — spans JSONL (with raw t0/t1 for the timeline), metrics
+snapshot, trace-event ring, ledger attribution rows, health state —
+into ``<run_dir>/obs-host-<k>/`` via the atomic tmp+fsync+replace
+discipline of ``io/model_io.atomic_write_bytes``. ``bundle.json`` is
+written LAST and is the commit point: a rank that died mid-ship leaves
+no bundle.json and the merge names the gap instead of reading a torn
+artifact. ``merge_chrome_trace`` renders all bundles as ONE
+Perfetto-loadable timeline (pid per rank,
+``trace.validate_chrome_trace``-clean); ``straggler_report`` is the
+fleet ledger rollup: per-rank attributed dispatch seconds, per-program
+max−min window skew, the slowest rank, and a collective-vs-compute
+split where each rank's barrier wait is the residual between the fleet
+wall window and its own attributed compute — the wait a straggling peer
+imposes through the collectives.
+
+Degradation is first-class: a truncated spans.jsonl (crashed rank), an
+unreadable bundle.json, or a missing rank all land in the ``gaps`` list
+carried by both the merged trace's ``otherData`` and the straggler
+report — a partial fleet still merges, it just says what is missing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+BUNDLE_SCHEMA = 1
+HOST_DIR_PREFIX = "obs-host-"
+BUNDLE_FILE = "bundle.json"
+SPANS_FILE = "spans.jsonl"
+
+# Per-bundle ring clamps (the flight recorder's post-mortem-sized
+# defaults would truncate a full run; bundles ship the whole ring).
+_EVENT_LIMIT = 8192
+
+# Host-concurrency contract (audited by `python -m photon_tpu.analysis
+# --concurrency`). The cached identity block, run id, and init-time
+# clock sample are module state written under the one module lock;
+# ``ship_bundle`` may be called from any thread (the train driver, a
+# pilot stage, an atexit hook) — it reads ring SNAPSHOTS via the other
+# modules' own locks and writes files outside any lock. The merge side
+# (discover/merge/report) only touches local state read from disk.
+CONCURRENCY_AUDIT = dict(
+    name="obs-fleet",
+    locks={
+        "_lock": ("_identity", "_run_id", "_init_clock"),
+    },
+    thread_entries=("ship_bundle",),
+    jax_dispatch_ok={},
+)
+
+_lock = threading.Lock()
+_identity: dict | None = None
+_run_id: str | None = None
+_init_clock: dict | None = None
+
+
+# --------------------------------------------------------------------------
+# host identity
+# --------------------------------------------------------------------------
+
+
+def _probe_identity() -> dict:
+    """Assemble the provenance block for THIS process. jax is consulted
+    only when the process already imported it — identity stamping must
+    never initialize a backend as a side effect — and every jax query is
+    guarded: a half-up runtime degrades to nulls, never to a failed
+    snapshot/dump."""
+    ident: dict = {
+        "process_index": 0,
+        "process_count": 1,
+        "hostname": socket.gethostname(),
+        "pid": os.getpid(),
+        "device_kind": None,
+        "local_device_count": None,
+        "global_device_count": None,
+        "jax_version": None,
+    }
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        ident["jax_version"] = getattr(jax, "__version__", None)
+        try:
+            ident["process_index"] = int(jax.process_index())
+            ident["process_count"] = int(jax.process_count())
+            devices = jax.local_devices()
+            ident["local_device_count"] = len(devices)
+            ident["global_device_count"] = len(jax.devices())
+            if devices:
+                ident["device_kind"] = getattr(
+                    devices[0], "device_kind", None
+                )
+        except Exception:  # noqa: BLE001 — backend not up / mid-teardown
+            pass
+    return ident
+
+
+def host_identity(*, refresh: bool = False) -> dict:
+    """The host-identity provenance block (cached; ``refresh=True``
+    re-probes — bundle commit does, so a block cached before
+    ``jax.distributed.initialize`` cannot ship a stale rank)."""
+    global _identity
+    with _lock:
+        cached = _identity
+    if cached is None or refresh:
+        probed = _probe_identity()
+        with _lock:
+            _identity = probed
+            cached = probed
+    out = dict(cached)
+    out["run_id"] = run_id()
+    return out
+
+
+def set_run_id(value: str | None) -> None:
+    """Pin the fleet-shared run id (the coordinator mints one and the
+    launcher exports it to every rank via ``PHOTON_RUN_ID``)."""
+    global _run_id
+    with _lock:
+        _run_id = value
+
+
+def run_id() -> str | None:
+    """The run id: an explicit ``set_run_id`` wins, else the
+    ``PHOTON_RUN_ID`` environment (how the multiprocess launcher shares
+    one id across ranks), else None."""
+    with _lock:
+        rid = _run_id
+    return rid if rid is not None else os.environ.get("PHOTON_RUN_ID")
+
+
+def reset() -> None:
+    """Drop the cached identity, run id, and init clock sample (joined
+    into ``obs.reset()`` — identity re-probes lazily on next use)."""
+    global _identity, _run_id, _init_clock
+    with _lock:
+        _identity = None
+        _run_id = None
+        _init_clock = None
+
+
+# --------------------------------------------------------------------------
+# clock alignment
+# --------------------------------------------------------------------------
+
+
+def clock_sample(n: int = 5) -> dict:
+    """One monotonic↔epoch offset measurement: ``n`` back-to-back
+    (epoch, perf_counter) pairs. ``offset`` maps perf_counter seconds
+    onto the epoch clock (``epoch ≈ perf + offset``); ``spread`` (the
+    max−min of the per-pair offsets) bounds the scheduling jitter of the
+    measurement itself."""
+    offsets = []
+    epoch = perf = 0.0
+    for _ in range(max(int(n), 1)):
+        perf = time.perf_counter()
+        epoch = time.time()
+        offsets.append(epoch - perf)
+    offsets.sort()
+    return {
+        "offset": offsets[len(offsets) // 2],
+        "spread": offsets[-1] - offsets[0],
+        "epoch": epoch,
+        "perf_counter": perf,
+    }
+
+
+def mark_init() -> dict:
+    """The init half of the clock-alignment handshake — called from
+    ``cli.common.maybe_init_distributed`` (and the multiprocess dryrun
+    children) right after the distributed runtime comes up. Also
+    refreshes the cached identity so the rank probed is post-init."""
+    sample = clock_sample()
+    global _init_clock
+    with _lock:
+        _init_clock = sample
+    host_identity(refresh=True)
+    return sample
+
+
+def init_clock() -> dict | None:
+    with _lock:
+        return None if _init_clock is None else dict(_init_clock)
+
+
+def clock_alignment() -> dict:
+    """The commit half of the handshake: a fresh offset sample paired
+    with the init-time one. ``skew_bound_seconds`` bounds how far this
+    host's perf→epoch mapping may have drifted over the run: the offset
+    delta between the two samples plus both sampling spreads. With no
+    init sample (single-process run that never called ``mark_init``) the
+    commit sample stands alone and the bound is its own spread."""
+    commit = clock_sample()
+    init = init_clock() or commit
+    bound = (
+        abs(commit["offset"] - init["offset"])
+        + commit["spread"]
+        + init["spread"]
+    )
+    return {
+        "init": init,
+        "commit": commit,
+        "skew_bound_seconds": bound,
+    }
+
+
+# --------------------------------------------------------------------------
+# bundle shipping (the per-rank write side)
+# --------------------------------------------------------------------------
+
+
+def host_dir(run_dir: str, process_index: int) -> str:
+    return os.path.join(run_dir, f"{HOST_DIR_PREFIX}{process_index}")
+
+
+def ship_bundle(run_dir: str, *, extra: dict | None = None) -> str:
+    """Commit this rank's obs state into ``<run_dir>/obs-host-<k>/``.
+
+    Two files, both via the atomic tmp+fsync+replace discipline:
+    ``spans.jsonl`` (telemetry header + one ``span`` record per
+    completed span, carrying raw ``t0``/``t1`` perf_counter stamps for
+    the timeline merge) and — LAST, as the commit point — ``bundle.json``
+    (identity, clock alignment, metrics snapshot, trace-event ring,
+    ledger attribution rows, health state). Returns the bundle dir.
+    ``extra`` merges caller context (the dryrun ships its parity verdict
+    through it) into the bundle's ``extra`` block.
+    """
+    from photon_tpu import obs
+    from photon_tpu.obs import health, ledger
+    from photon_tpu.obs import trace as obs_trace
+    from photon_tpu.io.model_io import atomic_write_bytes
+
+    ident = host_identity(refresh=True)
+    out_dir = host_dir(run_dir, ident["process_index"])
+    os.makedirs(out_dir, exist_ok=True)
+
+    lines: list[dict] = [{
+        "type": "telemetry",
+        "version": 1,
+        "spans_dropped": obs.TRACER.dropped,
+        "host": ident,
+    }]
+    for sp in obs.TRACER.completed():
+        lines.append(dict(sp.to_json(), t0=sp.t0, t1=sp.t1))
+    payload = "".join(json.dumps(line) + "\n" for line in lines)
+    atomic_write_bytes(
+        os.path.join(out_dir, SPANS_FILE), payload.encode()
+    )
+
+    bundle: dict = {
+        "schema": BUNDLE_SCHEMA,
+        "host": ident,
+        "clock": clock_alignment(),
+        "metrics": obs.REGISTRY.snapshot(),
+        "events": obs_trace.events()[-_EVENT_LIMIT:],
+        "events_dropped": obs_trace.dropped(),
+        "spans_dropped": obs.TRACER.dropped,
+        "ledger": ledger.snapshot() if ledger.enabled() else None,
+        "health": health.raw_snapshot() if health.enabled() else None,
+        "extra": dict(extra or {}),
+    }
+    atomic_write_bytes(
+        os.path.join(out_dir, BUNDLE_FILE),
+        json.dumps(bundle).encode(),
+    )
+    return out_dir
+
+
+# --------------------------------------------------------------------------
+# discovery + merge (the fleetview read side)
+# --------------------------------------------------------------------------
+
+
+def discover_bundles(run_dir: str) -> tuple[list[dict], list[str]]:
+    """Read every committed ``obs-host-*/`` bundle under ``run_dir``.
+
+    Returns ``(bundles, gaps)``: each bundle is its ``bundle.json`` dict
+    plus a ``"spans"`` list parsed from ``spans.jsonl`` and a ``"dir"``.
+    Anything broken degrades to a NAMED gap, never an exception: a host
+    dir without a committed bundle.json (rank died before the commit
+    point), an unparseable bundle, or a truncated spans.jsonl (the span
+    records before the tear are kept).
+    """
+    bundles: list[dict] = []
+    gaps: list[str] = []
+    try:
+        entries = sorted(os.listdir(run_dir))
+    except OSError as exc:
+        return [], [f"{run_dir}: unreadable run dir ({exc})"]
+    for name in entries:
+        if not name.startswith(HOST_DIR_PREFIX):
+            continue
+        d = os.path.join(run_dir, name)
+        if not os.path.isdir(d):
+            continue
+        bundle_path = os.path.join(d, BUNDLE_FILE)
+        try:
+            with open(bundle_path) as f:
+                bundle = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            gaps.append(
+                f"{name}: no committed bundle.json ({exc}) — rank "
+                "died before the bundle commit point"
+            )
+            continue
+        if not isinstance(bundle, dict) or "host" not in bundle:
+            gaps.append(f"{name}: bundle.json missing the host block")
+            continue
+        spans, span_gap = _read_spans(os.path.join(d, SPANS_FILE))
+        if span_gap:
+            gaps.append(f"{name}: {span_gap}")
+        bundle["spans"] = spans
+        bundle["dir"] = d
+        bundles.append(bundle)
+    bundles.sort(
+        key=lambda b: b.get("host", {}).get("process_index", 0)
+    )
+    return bundles, gaps
+
+
+def _read_spans(path: str) -> tuple[list[dict], str | None]:
+    """Parse a bundle's spans.jsonl; a torn tail (crashed rank) keeps
+    every record before the tear and names the gap."""
+    spans: list[dict] = []
+    try:
+        with open(path) as f:
+            raw_lines = f.readlines()
+    except OSError as exc:
+        return [], f"spans.jsonl unreadable ({exc})"
+    for lineno, raw in enumerate(raw_lines, 1):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            rec = json.loads(raw)
+        except json.JSONDecodeError:
+            return spans, (
+                f"spans.jsonl truncated at line {lineno} — kept "
+                f"{len(spans)} span(s) before the tear"
+            )
+        if rec.get("type") == "span" and "t0" in rec and "t1" in rec:
+            spans.append(rec)
+    return spans, None
+
+
+def _to_epoch(bundle: dict, t_perf: float) -> float:
+    """Map a bundle's perf_counter stamp onto the epoch clock through
+    its commit-time offset sample."""
+    clock = bundle.get("clock") or {}
+    commit = clock.get("commit") or {}
+    return t_perf + float(commit.get("offset", 0.0))
+
+
+def _bundle_rank(bundle: dict) -> int:
+    return int(bundle.get("host", {}).get("process_index", 0))
+
+
+def _epoch0(bundles: list[dict]) -> float:
+    """The merged timeline's zero: the earliest epoch instant any
+    bundle knows about (first span start, first ring event, else the
+    commit sample itself)."""
+    starts: list[float] = []
+    for b in bundles:
+        spans = b.get("spans", ())
+        if spans:
+            # Spans record in COMPLETION order (a parent completes after
+            # its children), so the earliest start needs the full scan.
+            starts.append(
+                _to_epoch(b, min(float(sp["t0"]) for sp in spans))
+            )
+        for ev in b.get("events", ()) or ():
+            if "ts" in ev:
+                starts.append(_to_epoch(b, float(ev["ts"])))
+                break
+        commit = (b.get("clock") or {}).get("commit") or {}
+        if "epoch" in commit:
+            starts.append(float(commit["epoch"]))
+    return min(starts) if starts else 0.0
+
+
+def merge_chrome_trace(
+    bundles: list[dict], gaps: tuple[str, ...] | list[str] = ()
+) -> dict:
+    """All bundles on ONE chrome-trace timeline: pid per rank, each
+    host's perf_counter stamps shifted onto the shared epoch clock
+    through its own offset, events sorted by fleet time. The document
+    passes ``trace.validate_chrome_trace``; ``otherData`` carries the
+    fleet provenance, per-host clock bounds, and any merge gaps."""
+    from photon_tpu.obs.trace import _request_chrome_events, _us
+
+    epoch0 = _epoch0(bundles)
+    out: list[dict] = []
+    hosts_meta: list[dict] = []
+    skew_bounds: list[float] = []
+
+    for b in bundles:
+        ident = b.get("host", {})
+        pid = _bundle_rank(b)
+        clock = b.get("clock") or {}
+        bound = float(clock.get("skew_bound_seconds", 0.0))
+        skew_bounds.append(bound)
+        hosts_meta.append({
+            "process_index": pid,
+            "hostname": ident.get("hostname"),
+            "pid": ident.get("pid"),
+            "run_id": ident.get("run_id"),
+            "clock_skew_bound_seconds": bound,
+            "spans": len(b.get("spans", ())),
+            "events": len(b.get("events", ()) or ()),
+        })
+
+        def fleet_us(t_perf: float, b=b) -> float:
+            return _us(_to_epoch(b, float(t_perf)) - epoch0)
+
+        out.append({
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {
+                "name": f"rank {pid} · {ident.get('hostname', '?')}"
+            },
+        })
+        out.append({
+            "name": "process_sort_index", "ph": "M", "pid": pid,
+            "args": {"sort_index": pid},
+        })
+        tids: dict[str, int] = {}
+
+        def tid_for(thread: str, pid=pid, tids=tids) -> int:
+            t = tids.get(thread)
+            if t is None:
+                t = tids[thread] = len(tids) + 1
+                out.append({
+                    "name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": t, "args": {"name": thread},
+                })
+            return t
+
+        for sp in b.get("spans", ()):
+            args: dict = {"path": sp.get("path")}
+            if sp.get("attrs"):
+                args.update(sp["attrs"])
+            if sp.get("device_wait_seconds") is not None:
+                args["device_wait_seconds"] = sp["device_wait_seconds"]
+            t0, t1 = float(sp["t0"]), float(sp["t1"])
+            out.append({
+                "name": sp.get("name", "span"), "cat": "span",
+                "ph": "X", "ts": fleet_us(t0),
+                "dur": _us(max(t1 - t0, 0.0)),
+                "pid": pid, "tid": tid_for(sp.get("thread", "main")),
+                "args": args,
+            })
+        for ev in b.get("events", ()) or ():
+            kind = ev.get("kind")
+            if kind == "instant":
+                out.append({
+                    "name": ev["name"], "cat": ev.get("cat", "event"),
+                    "ph": "i", "s": "t", "ts": fleet_us(ev["ts"]),
+                    "pid": pid,
+                    "tid": tid_for(ev.get("thread", "events")),
+                    "args": dict(ev.get("args") or {}),
+                })
+            elif kind == "counter":
+                out.append({
+                    "name": ev["name"], "ph": "C",
+                    "ts": fleet_us(ev["ts"]), "pid": pid,
+                    "args": {"value": ev["value"]},
+                })
+            elif kind == "request":
+                shifted = dict(ev)
+                for k, v in ev.items():
+                    if k.endswith("_ts") and isinstance(v, (int, float)):
+                        shifted[k] = _to_epoch(b, float(v)) - epoch0
+                out.extend(_request_chrome_events(shifted, pid))
+
+    # Stable fleet order: metadata first, then strictly by fleet time —
+    # the "monotonic single timeline" the merge promises.
+    out.sort(key=lambda ev: (ev["ph"] != "M", ev.get("ts", 0.0)))
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "photon_tpu.obs.fleet",
+            "schema": BUNDLE_SCHEMA,
+            "epoch0": epoch0,
+            "hosts": hosts_meta,
+            "clock_skew_bound_seconds": (
+                max(skew_bounds) if skew_bounds else 0.0
+            ),
+            "gaps": list(gaps),
+        },
+    }
+
+
+# --------------------------------------------------------------------------
+# fleet ledger rollup + straggler report
+# --------------------------------------------------------------------------
+
+
+def _rank_window(bundle: dict) -> tuple[float, float] | None:
+    """A rank's dispatch window on the fleet epoch clock: first span
+    start → last span end (spans are the recorded work envelope)."""
+    spans = bundle.get("spans", ())
+    if not spans:
+        return None
+    t0 = min(float(sp["t0"]) for sp in spans)
+    t1 = max(float(sp["t1"]) for sp in spans)
+    return _to_epoch(bundle, t0), _to_epoch(bundle, t1)
+
+
+def _ledger_rows(bundle: dict) -> list[dict]:
+    led = bundle.get("ledger") or {}
+    return list(led.get("rows", ()) or ())
+
+
+def straggler_report(
+    bundles: list[dict], gaps: tuple[str, ...] | list[str] = ()
+) -> dict:
+    """The fleet ledger rollup + straggler analysis.
+
+    Per rank: attributed dispatch seconds (sum of its ledger rows, the
+    PR 12 attribution), dispatch count, and its work window on the fleet
+    clock. Per program dispatched on all ranks: per-rank seconds and the
+    max−min completion-window skew. The collective-vs-compute split is
+    the barrier-wait residual: the fleet wall window is set by the
+    slowest rank, every other rank spends (wall − own attributed
+    seconds) waiting inside the collectives that keep SPMD ranks in
+    lockstep, so ``collective_fraction`` = that wait summed over ranks /
+    (ranks × wall). The split is an attribution *estimate* — gloo/ICI
+    give no per-collective host timestamps — but its inputs (windows,
+    attributed seconds, clock bound) are all measured.
+    """
+    per_rank: list[dict] = []
+    windows: dict[int, tuple[float, float]] = {}
+    attributed: dict[int, float] = {}
+    prog_rank_seconds: dict[str, dict[int, float]] = {}
+    prog_rank_windows: dict[str, dict[int, tuple[float, float]]] = {}
+    skew_bounds: list[float] = []
+    process_count = 0
+
+    for b in bundles:
+        rank = _bundle_rank(b)
+        ident = b.get("host", {})
+        process_count = max(
+            process_count, int(ident.get("process_count", 1))
+        )
+        clock = b.get("clock") or {}
+        skew_bounds.append(float(clock.get("skew_bound_seconds", 0.0)))
+        rows = _ledger_rows(b)
+        att = sum(float(r.get("seconds", 0.0)) for r in rows)
+        dispatches = sum(int(r.get("dispatches", 0)) for r in rows)
+        win = _rank_window(b)
+        if win is not None:
+            windows[rank] = win
+        if not rows and win is not None:
+            # Ledger-off rank: fall back to the span window as the
+            # attributed envelope so the report still ranks it.
+            att = win[1] - win[0]
+        attributed[rank] = att
+        for r in rows:
+            prog = str(r.get("program", "?"))
+            prog_rank_seconds.setdefault(prog, {})
+            prog_rank_seconds[prog][rank] = (
+                prog_rank_seconds[prog].get(rank, 0.0)
+                + float(r.get("seconds", 0.0))
+            )
+        for sp in b.get("spans", ()):
+            name = str(sp.get("name", "?"))
+            e0 = _to_epoch(b, float(sp["t0"]))
+            e1 = _to_epoch(b, float(sp["t1"]))
+            by_rank = prog_rank_windows.setdefault(name, {})
+            if rank in by_rank:
+                w0, w1 = by_rank[rank]
+                by_rank[rank] = (min(w0, e0), max(w1, e1))
+            else:
+                by_rank[rank] = (e0, e1)
+        per_rank.append({
+            "process_index": rank,
+            "hostname": ident.get("hostname"),
+            "pid": ident.get("pid"),
+            "attributed_seconds": round(att, 6),
+            "dispatches": dispatches,
+            "window": (
+                None if win is None else {
+                    "start": win[0],
+                    "end": win[1],
+                    "seconds": round(win[1] - win[0], 6),
+                }
+            ),
+        })
+
+    ranks = sorted(attributed)
+    process_count = max(process_count, len(ranks), 1)
+    missing = [
+        k for k in range(process_count) if k not in set(ranks)
+    ]
+    gaps = list(gaps) + [
+        f"rank {k}: no bundle shipped" for k in missing
+    ]
+
+    wall = max(
+        (w[1] - w[0] for w in windows.values()), default=0.0
+    )
+    total_wait = 0.0
+    for row in per_rank:
+        wait = max(0.0, wall - row["attributed_seconds"])
+        row["collective_wait_seconds"] = round(wait, 6)
+        total_wait += wait
+    collective_fraction = (
+        total_wait / (len(per_rank) * wall)
+        if per_rank and wall > 0 else 0.0
+    )
+
+    straggler = None
+    if attributed:
+        worst = max(attributed, key=lambda k: attributed[k])
+        straggler = {
+            "process_index": worst,
+            "attributed_seconds": round(attributed[worst], 6),
+        }
+    straggler_skew = (
+        max(attributed.values()) - min(attributed.values())
+        if attributed else 0.0
+    )
+
+    programs: dict[str, dict] = {}
+    for prog in sorted(set(prog_rank_seconds) | set(prog_rank_windows)):
+        secs = prog_rank_seconds.get(prog, {})
+        wins = prog_rank_windows.get(prog, {})
+        on_all = set(secs or wins) >= set(ranks) and bool(ranks)
+        entry: dict = {
+            "per_rank_seconds": {
+                str(k): round(v, 6) for k, v in sorted(secs.items())
+            },
+            "on_all_ranks": on_all,
+        }
+        if wins:
+            # max−min completion skew: spread of when each rank FINISHED
+            # this program's window on the fleet clock.
+            ends = {k: w[1] for k, w in wins.items()}
+            entry["window_skew_seconds"] = round(
+                max(ends.values()) - min(ends.values()), 6
+            )
+        if secs:
+            entry["slowest_rank"] = max(secs, key=lambda k: secs[k])
+            entry["seconds_skew"] = round(
+                max(secs.values()) - min(secs.values()), 6
+            )
+        programs[prog] = entry
+
+    return {
+        "schema": BUNDLE_SCHEMA,
+        "bundles": len(bundles),
+        "process_count": process_count,
+        "ranks": ranks,
+        "missing_ranks": missing,
+        "gaps": gaps,
+        "per_rank": per_rank,
+        "straggler": straggler,
+        "straggler_skew_seconds": round(straggler_skew, 6),
+        "wall_seconds": round(wall, 6),
+        "collective_fraction": round(collective_fraction, 6),
+        "clock_skew_bound_seconds": (
+            max(skew_bounds) if skew_bounds else 0.0
+        ),
+        "programs": programs,
+    }
+
+
+def merge_run(
+    run_dir: str,
+    *,
+    trace_path: str | None = None,
+) -> tuple[dict, dict]:
+    """Discover, merge, and report in one call (the fleetview CLI's and
+    the multiprocess dryrun's entry point). Returns ``(report,
+    trace_doc)``; ``trace_path`` additionally writes the merged
+    timeline (atomically — the artifact CI validates)."""
+    bundles, gaps = discover_bundles(run_dir)
+    trace_doc = merge_chrome_trace(bundles, gaps)
+    report = straggler_report(bundles, gaps)
+    if trace_path is not None and bundles:
+        from photon_tpu.io.model_io import atomic_write_bytes
+
+        atomic_write_bytes(
+            trace_path, json.dumps(trace_doc).encode()
+        )
+    return report, trace_doc
+
+
+# --------------------------------------------------------------------------
+# MULTICHIP artifact row + monitor-port arbitration
+# --------------------------------------------------------------------------
+
+
+def multichip_row(report: dict, *, n_devices: int | None = None) -> dict:
+    """Flatten a straggler report into the MULTICHIP_r*.json row shape.
+
+    Schema 2 keeps the driver-era keys (``n_devices``, ``ok``) and adds
+    the structured attribution benchtrend tracks (the ``multichip_*``
+    gauges); the full report rides along under ``"report"``."""
+    return {
+        "schema": 2,
+        "n_devices": n_devices,
+        "ok": bool(report.get("bundles")) and not report.get("gaps"),
+        "process_count": report.get("process_count"),
+        "bundles": report.get("bundles"),
+        "per_rank_dispatch_seconds": {
+            str(row["process_index"]): row["attributed_seconds"]
+            for row in report.get("per_rank", ())
+        },
+        "multichip_straggler_skew_seconds": report.get(
+            "straggler_skew_seconds"
+        ),
+        "multichip_collective_fraction": report.get(
+            "collective_fraction"
+        ),
+        "multichip_clock_skew_bound_seconds": report.get(
+            "clock_skew_bound_seconds"
+        ),
+        "report": report,
+    }
+
+
+def write_multichip_row(
+    row: dict, *, root: str = ".", start: int = 1
+) -> str:
+    """Commit a MULTICHIP row into the next free ``MULTICHIP_r<NN>.json``
+    slot under ``root`` (atomic; the dryrun driver's artifact)."""
+    from photon_tpu.io.model_io import atomic_write_bytes
+
+    n = start
+    while os.path.exists(
+        os.path.join(root, f"MULTICHIP_r{n:02d}.json")
+    ):
+        n += 1
+    path = os.path.join(root, f"MULTICHIP_r{n:02d}.json")
+    atomic_write_bytes(path, json.dumps(row, indent=1).encode())
+    return path
+
+
+def resolve_monitor_port(
+    port: int, process_index: int | None = None
+) -> int:
+    """The per-rank /metrics bind port: ``port + process_index``, so
+    several ranks sharing a host never collide on one ``--monitor-port``
+    value. Port 0 (ephemeral, the OS picks) passes through untouched."""
+    if port <= 0:
+        return port
+    k = (
+        host_identity()["process_index"]
+        if process_index is None else int(process_index)
+    )
+    return port + k

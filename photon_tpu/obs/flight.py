@@ -105,9 +105,12 @@ class FlightRecorder:
     # -- dump ------------------------------------------------------------
 
     def dump(self, reason: str) -> str | None:
-        """Write ``flight-<pid>.json`` atomically; returns the path, or
-        None if the dump failed (a failing dump must never mask the
-        crash it is documenting — it logs and returns)."""
+        """Write ``flight-<pid>.json`` atomically (multi-process runs
+        suffix the rank: ``flight-<pid>-r<process_index>.json``, so two
+        ranks on one box can never clobber or confuse each other's
+        post-mortems); returns the path, or None if the dump failed (a
+        failing dump must never mask the crash it is documenting — it
+        logs and returns)."""
         try:
             # THE shared tmp+fsync+replace+dir-fsync dance (PR 7) — a
             # power loss right after the rename must not lose the one
@@ -116,9 +119,11 @@ class FlightRecorder:
 
             payload = self._payload(reason)
             os.makedirs(self.directory, exist_ok=True)
-            path = os.path.join(
-                self.directory, f"flight-{os.getpid()}.json"
-            )
+            host = payload.get("host") or {}
+            stem = f"flight-{os.getpid()}"
+            if (host.get("process_count") or 1) > 1:
+                stem += f"-r{host.get('process_index', 0)}"
+            path = os.path.join(self.directory, f"{stem}.json")
             atomic_write_bytes(path, json.dumps(payload).encode())
             return path
         except Exception:  # noqa: BLE001 — the crash path stays alive
@@ -140,6 +145,12 @@ class FlightRecorder:
             "perf_counter": time.perf_counter(),
             "installed_unix": self.installed_unix,
         }
+        try:
+            from photon_tpu.obs import fleet
+
+            out["host"] = fleet.host_identity()
+        except Exception as exc:  # noqa: BLE001
+            out["host_error"] = repr(exc)
         try:
             spans = obs.TRACER.completed()[-self.span_limit:]
             out["spans"] = [

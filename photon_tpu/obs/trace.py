@@ -352,6 +352,8 @@ def chrome_trace() -> dict:
                         "pid": pid, "args": {"value": v},
                     })
 
+    from photon_tpu.obs import fleet
+
     return {
         "traceEvents": out,
         "displayTimeUnit": "ms",
@@ -360,6 +362,7 @@ def chrome_trace() -> dict:
             "schema": 1,
             "spans_dropped": obs.TRACER.dropped,
             "events_dropped": dropped(),
+            "host": fleet.host_identity(),
         },
     }
 

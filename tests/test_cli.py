@@ -142,6 +142,42 @@ class TestTrainCLI:
         assert any(e["ph"] == "X" for e in doc["traceEvents"])
         assert not obs.enabled()  # left as found
 
+    def test_distributed_flag_ships_provenanced_bundle(
+        self, tmp_path, glmix_avro, capsys
+    ):
+        """--distributed on a single host: the rank ships a 1-rank fleet
+        bundle whose host block carries a derived run id (identical on
+        every rank by construction — it hashes the shared fleet dir) and
+        whose clock block pairs a REAL init-time sample against the
+        commit-time one (obs.reset() inside main() must not wipe the
+        init half of the handshake), and the run dir merges clean."""
+        from photon_tpu.cli.train import main
+        from photon_tpu.obs import fleet
+
+        train, val = glmix_avro
+        cfg_path, _ = _config(tmp_path, train, val)
+        try:
+            assert main(["--config", str(cfg_path), "--no-flight",
+                         "--distributed"]) == 0
+        finally:
+            fleet.reset()  # the derived run id is process state
+        capsys.readouterr()
+
+        fleet_dir = tmp_path / "out" / "fleet"
+        bundle = json.loads(
+            (fleet_dir / "obs-host-0" / "bundle.json").read_text())
+        host, clock = bundle["host"], bundle["clock"]
+        assert host["process_index"] == 0 and host["process_count"] == 1
+        assert host["run_id"] and host["run_id"].startswith("train-")
+        # A real pairing: init sampled at arm time, commit at ship time.
+        assert (clock["commit"]["perf_counter"]
+                > clock["init"]["perf_counter"])
+        assert clock["skew_bound_seconds"] < 1.0
+
+        report, _trace = fleet.merge_run(str(fleet_dir))
+        assert report["gaps"] == [] and report["ranks"] == [0]
+        assert report["wall_seconds"] > 0
+
     def test_lambda_grid_selects_best(self, tmp_path, glmix_avro, capsys):
         from photon_tpu.cli.train import main
 
