@@ -1,4 +1,4 @@
-"""photon_tpu.analysis — four static-analysis tiers that gate the package.
+"""photon_tpu.analysis — six static-analysis tiers that gate the package.
 
 Tier 1 is a pure-``ast`` lint pass (nothing analyzed is imported, no JAX
 needed at analysis time), so it runs in milliseconds on any machine. The
@@ -33,12 +33,35 @@ formula in model-dimension terms — so HBM growth and rotten budgets both
 fail CI, and ``predict_resident_bytes`` answers the admission question
 ("will this model fit") statically.
 
+Tier 5 (``--numerics``; analysis/numerics.py) audits the DTYPE FLOW of
+those same programs: a dtype-provenance lattice walk proves every
+reduction over bf16-stored values accumulates in f32 (into
+scan/while/cond bodies and across the Pallas boundary), censuses
+narrowing casts, prices a static worst-case rounding-error bound per
+program against declared ``NUMERICS_AUDIT`` budgets, and requires
+order-nondeterministic reductions to be declared
+deterministic-by-construction with a reason.
+
+Tier 6 (``--spmd``; analysis/spmd.py) audits the MULTI-HOST behavior of
+the mesh path on one CPU machine: each ``SPMD_AUDIT`` contract's entry
+points are traced under N simulated ``jax.process_index()`` values (jit
+caches cleared per host, so the proof cannot be satisfied by cache
+replay) and the jaxprs must be byte-identical; the ordered collective
+sequence of each host's compiled HLO must match position-by-position (a
+mismatch is the deadlock, named statically); a host-divergence AST lint
+flags time/env/pid/``process_index``/unseeded-RNG values flowing into
+shapes or trace-affecting branches; and the declared ``PARTITION_RULES``
+tree must cover every placed pytree leaf exactly once, implicit reshards
+priced as bytes over the interconnect.
+
 Usage::
 
     python -m photon_tpu.analysis photon_tpu/            # tier-1 gate
     python -m photon_tpu.analysis --semantic             # tier-2 gate
     python -m photon_tpu.analysis --concurrency          # tier-3 gate
     python -m photon_tpu.analysis --memory               # tier-4 gate
+    python -m photon_tpu.analysis --numerics             # tier-5 gate
+    python -m photon_tpu.analysis --spmd                 # tier-6 gate
     python -m photon_tpu.analysis --list-rules
     python -m photon_tpu.analysis --format json photon_tpu/data/
 

@@ -1,6 +1,6 @@
 """CLI: ``python -m photon_tpu.analysis [paths...]``.
 
-Five tiers share this entry point:
+Six tiers share this entry point:
 
 - default: the tier-1 pure-``ast`` lint pass over source files;
 - ``--semantic``: the tier-2 program auditor (analysis/program.py) —
@@ -22,6 +22,13 @@ Five tiers share this entry point:
   static worst-case error budgets, and the reduction-determinism
   census, against the declared ``NUMERICS_AUDIT`` contracts. Needs JAX
   (CPU is fine; no device execution).
+- ``--spmd``: the tier-6 SPMD auditor (analysis/spmd.py) — cross-host
+  trace-determinism proofs under simulated ``process_index`` 0..N-1,
+  the host-divergence AST lint, the ordered collective-order deadlock
+  census, and partition-rule coverage, against the declared
+  ``SPMD_AUDIT`` contracts. ``--hosts N`` sets the simulated fleet
+  size. Needs JAX (CPU is fine; no devices beyond the virtual
+  platform, no distributed runtime).
 
 Exit codes: 0 clean (or only suppressed findings), 1 unsuppressed
 findings, 2 usage error.
@@ -106,6 +113,20 @@ def main(argv: list[str] | None = None) -> int:
         "NUMERICS_AUDIT contracts) instead of the source lint",
     )
     parser.add_argument(
+        "--spmd",
+        action="store_true",
+        help="run the tier-6 SPMD auditor (cross-host trace proofs, "
+        "host-divergence lint, collective-order census, partition-rule "
+        "coverage, SPMD_AUDIT contracts) instead of the source lint",
+    )
+    parser.add_argument(
+        "--hosts",
+        type=int,
+        metavar="N",
+        help="with --spmd: simulate an N-process fleet (default: each "
+        "contract's declared host count)",
+    )
+    parser.add_argument(
         "--cost-out",
         metavar="PATH",
         help="with --semantic: also write the per-program cost-model/"
@@ -118,22 +139,53 @@ def main(argv: list[str] | None = None) -> int:
             from photon_tpu.analysis import concurrency
 
             print(concurrency.render_rule_list())
+        elif args.spmd:
+            from photon_tpu.analysis import spmd
+
+            print(spmd.render_rule_list())
         else:
             print(render_rule_list())
         return 0
 
     if sum(
-        (args.semantic, args.concurrency, args.memory, args.numerics)
+        (
+            args.semantic,
+            args.concurrency,
+            args.memory,
+            args.numerics,
+            args.spmd,
+        )
     ) > 1:
         print(
-            "--semantic, --concurrency, --memory, and --numerics are "
-            "separate tiers; run them as separate invocations",
+            "--semantic, --concurrency, --memory, --numerics, and "
+            "--spmd are separate tiers; run them as separate "
+            "invocations",
             file=sys.stderr,
         )
         return 2
     if args.cost_out and not args.semantic:
         print("--cost-out requires --semantic", file=sys.stderr)
         return 2
+    if args.hosts is not None and not args.spmd:
+        print("--hosts requires --spmd", file=sys.stderr)
+        return 2
+    if args.spmd:
+        if args.paths or args.select:
+            print(
+                "--spmd audits the package's declared SPMD contracts "
+                "(the lint half always covers the whole package); "
+                "paths/--select do not apply",
+                file=sys.stderr,
+            )
+            return 2
+        if args.hosts is not None and args.hosts < 2:
+            print(
+                "--hosts must be >= 2 (the cross-host proof needs a "
+                "fleet)",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_spmd(args)
     if args.numerics:
         if args.paths or args.select:
             print(
@@ -302,6 +354,50 @@ def _run_numerics(args) -> int:
                 for n, p in entry["programs"].items()
             )
             print(f"contract {cname}: {progs or 'no traced programs'}")
+            for note in entry["notes"]:
+                print(f"  note: {note}")
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+def _run_spmd(args) -> int:
+    from photon_tpu.analysis import spmd
+
+    findings, report = spmd.audit(hosts=args.hosts)
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_json() for f in findings],
+                    "report": report,
+                },
+                indent=2,
+            )
+        )
+    else:
+        out = render_text(findings, show_suppressed=args.show_suppressed)
+        if out:
+            print(out)
+        for cname, entry in report["contracts"].items():
+            progs = ", ".join(
+                f"{n}@{'ok' if p['identical'] else 'DIVERGENT'}"
+                f"[{' -> '.join(p['collectives']) or 'no collectives'}]"
+                for n, p in entry["programs"].items()
+            )
+            print(
+                f"contract {cname} ({entry['hosts']} hosts): "
+                f"{progs or 'no traced programs'}"
+            )
+            cov = entry.get("coverage")
+            if cov:
+                print(
+                    f"  coverage: {cov['leaves']} leaves / "
+                    f"{cov['rules']} rules"
+                    + (
+                        f"; UNCOVERED: {', '.join(cov['uncovered'])}"
+                        if cov["uncovered"]
+                        else ""
+                    )
+                )
             for note in entry["notes"]:
                 print(f"  note: {note}")
     return 1 if any(not f.suppressed for f in findings) else 0

@@ -16,12 +16,21 @@ execute, the analytic count only the useful model work.
 from __future__ import annotations
 
 import json
-from typing import Any, Mapping
+import re
+from typing import Any, Iterable, Mapping
 
 # Per-chip peaks used for the roofline summary. v5e is the repo's target
 # part (bench.py uses the same numbers for measured utilization).
+# ``ici_bytes_per_sec`` is the per-chip aggregate inter-chip-interconnect
+# bandwidth (v5e: 4 links x ~400 Gb/s); it prices collective transfers —
+# the --spmd auditor's implicit-reshard findings — as a per-dispatch
+# lower bound the same way hbm_bytes_per_sec prices local traffic.
 CHIP_PEAKS = {
-    "tpu_v5e": {"flops_per_sec": 197e12, "hbm_bytes_per_sec": 819e9},
+    "tpu_v5e": {
+        "flops_per_sec": 197e12,
+        "hbm_bytes_per_sec": 819e9,
+        "ici_bytes_per_sec": 186e9,
+    },
 }
 DEFAULT_CHIP = "tpu_v5e"
 
@@ -131,6 +140,71 @@ def fused_fit_report(
     return {
         "fused_fit": program_report(fused.lower(coords), chip),
         "materialize": program_report(fused.lower_materialize(coords), chip),
+    }
+
+
+# --------------------------------------------------------------------------
+# collective-transfer pricing (the --spmd implicit-reshard detector)
+# --------------------------------------------------------------------------
+
+# One HLO shape token: dtype[dims] — "f32[128,64]", "bf16[8]", "pred[]".
+# Tuple shapes of async collective pairs contain several tokens; summing
+# them prices the whole transfer.
+_HLO_SHAPE_RE = re.compile(
+    r"\b(pred|s8|u8|s16|u16|s32|u32|s64|u64|f8e\w+|bf16|f16|f32|f64"
+    r"|c64|c128)\[([0-9,]*)\]"
+)
+
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+
+def hlo_shape_bytes(shape_text: str) -> float:
+    """Total bytes of every dtype[dims] token in an HLO shape string.
+
+    Accepts the raw shape region of an instruction line — scalar
+    (``f32[]``), array (``f32[128,64]{1,0}``), or tuple
+    (``(f32[8]{0}, f32[8]{0})``) — and sums them all; layout annotations
+    are ignored. Unknown dtypes (future f8 variants) price at 1 byte —
+    an undercount, never a silent zero.
+    """
+    total = 0.0
+    for dtype, dims in _HLO_SHAPE_RE.findall(shape_text):
+        size = 1
+        for d in dims.split(","):
+            if d:
+                size *= int(d)
+        total += size * _HLO_DTYPE_BYTES.get(dtype, 1)
+    return total
+
+
+def collective_transfer(
+    sequence: Iterable[Mapping[str, str]], chip: str = DEFAULT_CHIP
+) -> dict[str, Any]:
+    """Price an ordered collective sequence as bytes over the interconnect.
+
+    ``sequence`` is ``spmd.collective_sequence`` output
+    (``[{"op", "shape"}, ...]``). Returns per-op bytes, the total, and
+    the ICI-bandwidth lower bound per dispatch — the cost an implicit
+    compiler-inserted reshard silently adds to every step.
+    """
+    ops: list[dict[str, Any]] = []
+    total = 0.0
+    for step in sequence:
+        b = hlo_shape_bytes(step.get("shape", ""))
+        total += b
+        ops.append({"op": step.get("op", "?"), "bytes": b})
+    peak = CHIP_PEAKS[chip].get("ici_bytes_per_sec")
+    return {
+        "chip": chip,
+        "ops": ops,
+        "total_bytes": total,
+        "min_seconds_ici": (total / peak) if peak else None,
     }
 
 

@@ -976,7 +976,7 @@ def build_serve_kernel_memory() -> MemoryTrace:
             for r in ladder.rungs
         }
     finally:
-        if prev is None:
+        if prev is None:  # photon: ignore[spmd-host-divergence] -- env save/restore of the audit fixture's kernel flag; host-local tooling, not fleet code
             os.environ.pop("PHOTON_SERVE_KERNEL", None)
         else:
             os.environ["PHOTON_SERVE_KERNEL"] = prev

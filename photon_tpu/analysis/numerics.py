@@ -1337,7 +1337,7 @@ def build_segment_reduce_numerics() -> NumericsTrace:
                 f"segment_sum_{tag}", traced.jaxpr
             )
         finally:
-            if prev is None:
+            if prev is None:  # photon: ignore[spmd-host-divergence] -- env save/restore of the audit fixture's kernel flag; host-local tooling, not fleet code
                 os.environ.pop("PHOTON_SEGMENT_KERNEL", None)
             else:
                 os.environ["PHOTON_SEGMENT_KERNEL"] = prev
@@ -1389,7 +1389,7 @@ def build_serve_kernel_numerics() -> NumericsTrace:
             for r in ladder.rungs
         }
     finally:
-        if prev is None:
+        if prev is None:  # photon: ignore[spmd-host-divergence] -- env save/restore of the audit fixture's kernel flag; host-local tooling, not fleet code
             os.environ.pop("PHOTON_SERVE_KERNEL", None)
         else:
             os.environ["PHOTON_SERVE_KERNEL"] = prev

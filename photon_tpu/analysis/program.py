@@ -46,6 +46,7 @@ import dataclasses
 import hashlib
 import importlib
 import os
+import re
 import sys
 from typing import Any, Callable, Iterable, Iterator
 
@@ -103,19 +104,23 @@ _CALLBACK_PRIMITIVES = frozenset(
     }
 )
 
-_COLLECTIVE_OPS = (
-    "all-reduce",
-    "all-gather",
-    "all-to-all",
-    "collective-permute",
-    "reduce-scatter",
-    "collective-broadcast",
-)
+# Cross-device transfer ops: owned since PR 20 by the tier-6 SPMD
+# census (analysis/spmd.py) — one list, one census, so the tier-2
+# sharding audit and the --spmd collective-order audit cannot drift.
+from photon_tpu.analysis.spmd import COLLECTIVE_OPS as _COLLECTIVE_OPS
 
 
 # --------------------------------------------------------------------------
 # data model
 # --------------------------------------------------------------------------
+
+
+# Function reprs inside higher-order primitive params (custom_jvp's
+# jvp_jaxpr_thunk and friends) embed id() addresses in the jaxpr text.
+# They vary per trace — across simulated hosts and across re-traces of
+# one config — without any semantic divergence, so both the tier-2
+# recompile-key proxy and the tier-6 cross-host proof scrub them.
+_JAXPR_ADDR_RE = re.compile(r" at 0x[0-9a-fA-F]+")
 
 
 @dataclasses.dataclass
@@ -129,6 +134,9 @@ class TracedProgram:
     text: str
     jaxpr: Any | None = None  # ClosedJaxpr; None for key-only programs
     lowered: Any | None = None
+
+    def __post_init__(self) -> None:
+        self.text = _JAXPR_ADDR_RE.sub(" at 0x", self.text)
 
     @property
     def signature(self) -> str:
@@ -413,9 +421,15 @@ def run_checks(
 
 
 def hlo_collectives(compiled: Any) -> list[str]:
-    """Collective op names present in a compiled executable's HLO text."""
-    txt = compiled.as_text()
-    return sorted(op for op in _COLLECTIVE_OPS if op in txt)
+    """Collective op names present in a compiled executable's HLO text.
+
+    Delegates to the tier-6 census (``spmd.collective_census``) — the
+    single source of truth the ``--spmd`` collective-order audit also
+    gates on, so the two tiers see the same ops by construction.
+    """
+    from photon_tpu.analysis import spmd
+
+    return spmd.collective_census(compiled)
 
 
 # --------------------------------------------------------------------------
@@ -950,7 +964,7 @@ def build_serve_kernel() -> ContractTrace:
             ],
         }
     finally:
-        if prev is None:
+        if prev is None:  # photon: ignore[spmd-host-divergence] -- env save/restore of the audit fixture's kernel flag; host-local tooling, not fleet code
             os.environ.pop("PHOTON_SERVE_KERNEL", None)
         else:
             os.environ["PHOTON_SERVE_KERNEL"] = prev
@@ -1323,7 +1337,9 @@ def build_fleet() -> ContractTrace:
             text = lowered.as_text()
         except Exception:  # noqa: BLE001 — backend without HLO text
             return []
-        return [op for op in _COLLECTIVE_OPS if op in text]
+        from photon_tpu.analysis import spmd
+
+        return spmd.collective_census(text)
 
     with _serial_ingest_env():
         est, data = _tiny_glmix()

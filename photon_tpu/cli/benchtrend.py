@@ -152,6 +152,27 @@ MULTICHIP_TRACKED: dict[str, tuple[str, float, tuple[str, ...]]] = {
     "multichip_collective_fraction": (
         "lower", 3.0, ("collective_fraction",)
     ),
+    # Round 20+: the dryrun's merged wall clock (fallback reaches into
+    # the nested report for rows written before the flat gauge landed —
+    # fallback keys may be dotted paths), the hosts-reporting count, and
+    # the static collective count the tier-6 census attached
+    # (fleet.crosscheck_collective_census). Hosts-reporting gates at
+    # 1.0x: ANY drop from the trailing best means a rank stopped
+    # shipping bundles — the fleet-side signature of the deadlock the
+    # --spmd collective-order rule proves against statically (CI pins
+    # the dryrun at 2 processes; an intentional fleet resize is a
+    # rebaseline, not noise). Collective count gates one-sided on
+    # growth: a new collective in the dryrun program is a new fleet
+    # barrier and should arrive with a contract change, not silently.
+    "multichip_wall_seconds": (
+        "lower", 3.0, ("report.wall_seconds",)
+    ),
+    "multichip_hosts_reporting": (
+        "higher", 1.0, ("bundles",)
+    ),
+    "multichip_collective_count": (
+        "lower", 1.0, ("report.collective_census.count",)
+    ),
 }
 
 # Waivers for BENCH-REPORTED regressions (the `regressions` list a
@@ -215,7 +236,14 @@ def metric_value(
 ) -> float | None:
     _, _, fallbacks = (tracked or TRACKED)[name]
     for key in (name, *fallbacks):
-        v = parsed.get(key)
+        # Fallback keys may be dotted paths ("report.wall_seconds") that
+        # walk nested dicts — multichip rows carry the merged fleet
+        # report inline, and its gauges predate the flat top-level ones.
+        v: object = parsed
+        for part in key.split("."):
+            v = v.get(part) if isinstance(v, dict) else None
+            if v is None:
+                break
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             return float(v)
     return None

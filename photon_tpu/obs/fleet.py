@@ -724,21 +724,59 @@ def merge_run(
 # --------------------------------------------------------------------------
 
 
+def crosscheck_collective_census(report: dict, census_ops) -> dict:
+    """Join the tier-6 STATIC collective census onto a merged fleet report.
+
+    ``census_ops`` is the ordered collective op list the SPMD auditor
+    extracted from the compiled HLO (``analysis.spmd
+    .collective_sequence`` op names, or the sorted census). The runtime
+    ledger observes collective *waits*; the static census says which
+    collectives every rank is contractually issuing — joining the two
+    makes a mismatched-collective hang attributable: a fleet whose
+    static census is non-empty but whose merged run is missing ranks is
+    presenting exactly the deadlock signature the ``--spmd``
+    collective-order rule proves against. The entry is stored under
+    ``report["collective_census"]`` (read by :func:`multichip_row` for
+    the benchtrend ``multichip_collective_count`` gauge) and returned.
+    """
+    ops = [str(o) for o in census_ops]
+    mismatches: list[str] = []
+    if ops:
+        for k in report.get("missing_ranks", ()):
+            mismatches.append(
+                f"static census orders {len(ops)} collective(s) "
+                f"({' -> '.join(ops)}) but rank {k} shipped no bundle — "
+                "a mismatched collective order presents exactly this "
+                "way; cross-check the --spmd collective-order audit"
+            )
+    entry = {
+        "source": "analysis.spmd",
+        "ops": ops,
+        "count": len(ops),
+        "mismatches": mismatches,
+    }
+    report["collective_census"] = entry
+    return entry
+
+
 def multichip_row(report: dict, *, n_devices: int | None = None) -> dict:
     """Flatten a straggler report into the MULTICHIP_r*.json row shape.
 
     Schema 2 keeps the driver-era keys (``n_devices``, ``ok``) and adds
     the structured attribution benchtrend tracks (the ``multichip_*``
-    gauges); the full report rides along under ``"report"``."""
-    return {
+    gauges — since PR 20 also the dryrun wall clock, the hosts-reporting
+    count, and the static collective count when
+    :func:`crosscheck_collective_census` ran); the full report rides
+    along under ``"report"``."""
+    row = {
         "schema": 2,
         "n_devices": n_devices,
         "ok": bool(report.get("bundles")) and not report.get("gaps"),
         "process_count": report.get("process_count"),
         "bundles": report.get("bundles"),
         "per_rank_dispatch_seconds": {
-            str(row["process_index"]): row["attributed_seconds"]
-            for row in report.get("per_rank", ())
+            str(r["process_index"]): r["attributed_seconds"]
+            for r in report.get("per_rank", ())
         },
         "multichip_straggler_skew_seconds": report.get(
             "straggler_skew_seconds"
@@ -749,8 +787,14 @@ def multichip_row(report: dict, *, n_devices: int | None = None) -> dict:
         "multichip_clock_skew_bound_seconds": report.get(
             "clock_skew_bound_seconds"
         ),
+        "multichip_wall_seconds": report.get("wall_seconds"),
+        "multichip_hosts_reporting": len(report.get("ranks", ())),
         "report": report,
     }
+    census = report.get("collective_census")
+    if census is not None:
+        row["multichip_collective_count"] = census.get("count")
+    return row
 
 
 def write_multichip_row(

@@ -81,7 +81,7 @@ def interpret_required() -> bool:
 
 def kernel_supported(task: TaskType, dtype, r: int, s: int) -> bool:
     flag = os.environ.get("PHOTON_NEWTON_KERNEL", "auto").lower()
-    if flag in ("0", "off", "false"):
+    if flag in ("0", "off", "false"):  # photon: ignore[spmd-host-divergence] -- kernel-select flag is launch config, exported fleet-uniform; divergence trips the --spmd trace proof
         return False
     if jnp.dtype(dtype) != jnp.float32:
         return False
@@ -90,7 +90,7 @@ def kernel_supported(task: TaskType, dtype, r: int, s: int) -> bool:
         return False
     if r * s > _MAX_RS:
         return False
-    if flag in ("1", "on", "force"):
+    if flag in ("1", "on", "force"):  # photon: ignore[spmd-host-divergence] -- kernel-select flag is launch config, exported fleet-uniform; divergence trips the --spmd trace proof
         # Callers pass interpret=interpret_required() so a forced run on
         # a non-TPU backend executes the interpreter path rather than
         # failing in Mosaic.

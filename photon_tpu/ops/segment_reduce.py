@@ -136,7 +136,7 @@ def kernel_supported(num_values: int, num_segments: int, dtype) -> bool:
     slow, for parity tests), ``off``/``0`` (always the XLA fallback).
     """
     flag = os.environ.get("PHOTON_SEGMENT_KERNEL", "auto").lower()
-    if flag in ("0", "off", "false"):
+    if flag in ("0", "off", "false"):  # photon: ignore[spmd-host-divergence] -- kernel-select flag is launch config, exported fleet-uniform; divergence trips the --spmd trace proof
         return False
     if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
                                 jnp.dtype(jnp.bfloat16)):
@@ -146,7 +146,7 @@ def kernel_supported(num_values: int, num_segments: int, dtype) -> bool:
     # int32 position/id arithmetic below: guard the flat sizes.
     if num_values >= 2**31 or num_segments >= 2**31:
         return False
-    if flag in ("1", "on", "force"):
+    if flag in ("1", "on", "force"):  # photon: ignore[spmd-host-divergence] -- kernel-select flag is launch config, exported fleet-uniform; divergence trips the --spmd trace proof
         return True
     return jax.default_backend() == "tpu"
 

@@ -135,12 +135,12 @@ def kernel_supported(dtype) -> bool:
     (always the jitted per-coordinate chain).
     """
     flag = os.environ.get("PHOTON_SERVE_KERNEL", "auto").lower()
-    if flag in ("0", "off", "false"):
+    if flag in ("0", "off", "false"):  # photon: ignore[spmd-host-divergence] -- kernel-select flag is launch config, exported fleet-uniform; divergence trips the --spmd trace proof
         return False
     if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
                                 jnp.dtype(jnp.bfloat16)):
         return False
-    if flag in ("1", "on", "force"):
+    if flag in ("1", "on", "force"):  # photon: ignore[spmd-host-divergence] -- kernel-select flag is launch config, exported fleet-uniform; divergence trips the --spmd trace proof
         return True
     return jax.default_backend() == "tpu"
 

@@ -18,6 +18,7 @@ row partitioning becomes an even row split.
 from __future__ import annotations
 
 import dataclasses as _dataclasses
+import re as _re
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +50,85 @@ PROGRAM_AUDIT = dict(
     axis=DATA_AXIS,
     allowed_collectives=("all-reduce",),
 )
+
+# SPMD contract (audited by `python -m photon_tpu.analysis --spmd`;
+# machinery in analysis/spmd.py): the sharded objective must trace to
+# byte-identical jaxprs on every simulated host, its compiled HLO must
+# carry the same ordered collective sequence on every host and nothing
+# beyond the gradient all-reduce, and every placed leaf must be covered
+# by exactly one PARTITION_RULES entry whose spec the placement agrees
+# with. This is the acceptance harness for the pjit/NamedSharding mesh
+# rebuild (ROADMAP item 1): the rebuild lands when it passes this
+# contract, and `covers` pins the tier-2 census to the tier-6 one so
+# the two audits cannot drift.
+SPMD_AUDIT = dict(
+    name="mesh-spmd",
+    entry="parallel.mesh.shard_batch / shard_random_effect_dataset "
+    "+ ops.glm objective",
+    builder="build_mesh_spmd",
+    hosts=2,
+    ordered_collectives=("all-reduce",),
+    partition_rules="PARTITION_RULES",
+    covers=("mesh-sharding",),
+)
+
+# The regex partition-rule tree for every leaf the mesh places, in the
+# match_partition_rules shape (first match wins; the SPMD auditor holds
+# the stronger line that exactly one rule matches each leaf). Leaf names
+# are slash-joined pytree paths: "fe/<field>" for the fixed-effect
+# batch, "re/block<i>/<field>" for random-effect plan arrays,
+# "re/raw*"/"re/score_*" for the shared scoring tables, "coef/*" for
+# coefficient vectors. The pjit rebuild (ROADMAP item 1) feeds these
+# specs to pjit instead of per-leaf device_put calls; until then they
+# document — and the auditor verifies — what the placement code does.
+PARTITION_RULES = (
+    # Fixed-effect batch leaves: rows sharded over the data axis
+    # (shard_batch pads to the device count first).
+    (r"^fe/(features|labels|offsets|weights|uids)$", P(DATA_AXIS)),
+    # Random-effect plan arrays: entity axis sharded — the per-entity
+    # solves are embarrassingly parallel (shard_random_effect_dataset).
+    (
+        r"^re/block\d+/(entity_codes|row_ids|row_counts|proj"
+        r"|intercept_slots)$",
+        P(DATA_AXIS),
+    ),
+    # Shared raw leaves: replicated — BlockPlans gather arbitrary rows,
+    # so every device needs the full table (the memory-for-zero-shuffle
+    # tradeoff documented on shard_random_effect_dataset).
+    (r"^re/raw(/|$)", P()),
+    # Residual-scorer tables: per-row work, rows sharded when divisible.
+    (r"^re/score_(codes|indices|values)$", P(DATA_AXIS)),
+    # Coefficients: replicated in HBM; gradients all-reduce into them.
+    (r"^coef(/|$)", P()),
+)
+
+
+def match_partition_rules(rules, leaves: dict):
+    """Map named leaves to PartitionSpecs via first-match regex rules.
+
+    ``leaves`` maps slash-joined pytree path names to arrays (anything
+    with ``ndim``). Scalars take ``P()`` without consuming a rule; an
+    array leaf no rule matches raises — silence here would mean a slab
+    lands wherever jit defaults put it. Returns ``(specs, matches)``
+    where ``matches[name]`` lists every matching rule index (the SPMD
+    auditor checks the list has length exactly 1).
+    """
+    specs: dict[str, P] = {}
+    matches: dict[str, list[int]] = {}
+    for name, leaf in leaves.items():
+        hit = [
+            i for i, (pat, _) in enumerate(rules) if _re.search(pat, name)
+        ]
+        matches[name] = hit
+        if int(getattr(leaf, "ndim", 0)) == 0:
+            specs[name] = P()
+        elif hit:
+            specs[name] = rules[hit[0]][1]
+        else:
+            raise ValueError(
+                f"no partition rule matches leaf {name!r}"
+            )
+    return specs, matches
 
 
 def shard_random_effect_dataset(

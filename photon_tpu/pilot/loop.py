@@ -239,7 +239,7 @@ class Pilot:
         )
         took = time.monotonic() - t0
         budget = self.config.stage_deadline_s.get(stage.lower())
-        if budget is not None and took > budget:
+        if budget is not None and took > budget:  # photon: ignore[spmd-host-divergence] -- host-side deadline/degrade control; selects retry posture, not which program is traced
             self.state.deadline_overruns += 1
             self.state.consecutive_failures += 1
             self._maybe_degrade(

@@ -161,9 +161,9 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
 
     global _dir_in_effect
 
-    if cache_dir is None:
+    if cache_dir is None:  # photon: ignore[spmd-host-divergence] -- cache dir is host-local config; changes where artifacts persist, never what is traced
         cache_dir = os.environ.get("PHOTON_COMPILE_CACHE", _DEFAULT_DIR)
-    if not cache_dir or cache_dir.lower() == "off":
+    if not cache_dir or cache_dir.lower() == "off":  # photon: ignore[spmd-host-divergence] -- cache dir is host-local config; changes where artifacts persist, never what is traced
         # Genuinely disable: a process that enabled the cache earlier
         # must stop persisting/hitting it, or cache_stats() would report
         # dir=None while the counters keep climbing.

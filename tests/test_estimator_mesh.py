@@ -186,7 +186,12 @@ class TestColumnFeatureSharding:
     # keeps tier-1 signal clean now AND starts passing silently the day
     # the import gains a version guard — at which point drop these marks.
     @pytest.mark.xfail(
-        strict=False, reason="jax 0.4.37 shard_map, failing at seed"
+        strict=False, reason=(
+            "diagnosed by the tier-6 SPMD auditor: divergent op "
+            "'shard_map' at stage trace (jax 0.4.37 has no "
+            "jax.shard_map; see analysis.spmd.diagnose_shard_map_path, "
+            "pinned in tests/test_analysis_spmd.py)"
+        )
     )
     def test_column_sharded_parity(self, rng):
         """Sharded-vs-unsharded coefficient parity for the wide solve —
@@ -219,7 +224,12 @@ class TestColumnFeatureSharding:
         )
 
     @pytest.mark.xfail(
-        strict=False, reason="jax 0.4.37 shard_map, failing at seed"
+        strict=False, reason=(
+            "diagnosed by the tier-6 SPMD auditor: divergent op "
+            "'shard_map' at stage trace (jax 0.4.37 has no "
+            "jax.shard_map; see analysis.spmd.diagnose_shard_map_path, "
+            "pinned in tests/test_analysis_spmd.py)"
+        )
     )
     def test_column_sharded_with_random_effect(self, rng):
         """tp fixed effect + ep random effect chained by residual routing."""
@@ -263,7 +273,12 @@ class TestColumnFeatureSharding:
             datasets["global"].features, FeatureShardedSparse)
 
     @pytest.mark.xfail(
-        strict=False, reason="jax 0.4.37 shard_map, failing at seed"
+        strict=False, reason=(
+            "diagnosed by the tier-6 SPMD auditor: divergent op "
+            "'shard_map' at stage trace (jax 0.4.37 has no "
+            "jax.shard_map; see analysis.spmd.diagnose_shard_map_path, "
+            "pinned in tests/test_analysis_spmd.py)"
+        )
     )
     def test_column_warm_start_across_configs(self, rng):
         """Lambda-ladder warm starts pad the trimmed model back into the
@@ -283,7 +298,12 @@ class TestColumnFeatureSharding:
             77,)
 
     @pytest.mark.xfail(
-        strict=False, reason="jax 0.4.37 shard_map, failing at seed"
+        strict=False, reason=(
+            "diagnosed by the tier-6 SPMD auditor: divergent op "
+            "'shard_map' at stage trace (jax 0.4.37 has no "
+            "jax.shard_map; see analysis.spmd.diagnose_shard_map_path, "
+            "pinned in tests/test_analysis_spmd.py)"
+        )
     )
     def test_column_incremental_training(self, rng):
         """The Gaussian prior from a trimmed (logical-d) model must pad into

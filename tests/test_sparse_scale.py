@@ -150,7 +150,12 @@ class TestFeatureAxisSharding:
     # seed. strict=False keeps tier-1 signal clean without masking the
     # day a version-guarded import fixes these — then drop the marks.
     @pytest.mark.xfail(
-        strict=False, reason="jax 0.4.37 shard_map, failing at seed"
+        strict=False, reason=(
+            "diagnosed by the tier-6 SPMD auditor: divergent op "
+            "'shard_map' at stage trace (jax 0.4.37 has no "
+            "jax.shard_map; see analysis.spmd.diagnose_shard_map_path, "
+            "pinned in tests/test_analysis_spmd.py)"
+        )
     )
     def test_sharded_matvecs_match_local(self, rng, devices):
         n, d = 64, 97  # deliberately not divisible by 8
@@ -179,7 +184,12 @@ class TestFeatureAxisSharding:
         assert np.all(np.asarray(sharded.rmatvec(g))[d:] == 0.0)
 
     @pytest.mark.xfail(
-        strict=False, reason="jax 0.4.37 shard_map, failing at seed"
+        strict=False, reason=(
+            "diagnosed by the tier-6 SPMD auditor: divergent op "
+            "'shard_map' at stage trace (jax 0.4.37 has no "
+            "jax.shard_map; see analysis.spmd.diagnose_shard_map_path, "
+            "pinned in tests/test_analysis_spmd.py)"
+        )
     )
     def test_million_feature_fit_over_mesh(self, rng, devices):
         """The SURVEY §7.3 bar: a fixed-effect fit at d >= 1M sparse
